@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 training benchmark, the TPU-native mirror of the
+reference's headline harness
+(``/root/reference/examples/tensorflow2/tensorflow2_synthetic_benchmark.py``:
+ResNet-50, synthetic ImageNet batches, SGD, DistributedGradientTape).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline: the reference's published 4x4-GPU tf_cnn_benchmarks figure,
+1656.82 images/sec over 16 Pascal GPUs = 103.55 images/sec/GPU
+(``/root/reference/docs/benchmarks.rst:30-43``; see BASELINE.md).
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:30-43
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128,
+                        help="per-chip batch size")
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-warmup", type=int, default=3)
+    parser.add_argument("--fp32", action="store_true",
+                        help="compute in float32 instead of bfloat16")
+    args = parser.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    axis = hvd.axis_name()
+    mesh = hvd.mesh()
+
+    model = ResNet50(num_classes=1000,
+                     dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
+                     axis_name=axis)
+    rng = jax.random.PRNGKey(0)
+    images_host = np.random.default_rng(0).standard_normal(
+        (n * args.batch_size, 224, 224, 3), dtype=np.float32)
+    labels_host = np.random.default_rng(1).integers(
+        0, 1000, size=(n * args.batch_size,))
+
+    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32),
+                           train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # Reference benchmark uses plain SGD lr=0.01; gradient sync through the
+    # framework's DistributedOptimizer (allreduce average over the mesh).
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt_state = tx.init(params)
+
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images, train=True,
+                mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(labels, 1000)
+            loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), -1))
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_stats, new_opt, loss
+
+    sharded_step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False))
+
+    data_sharding = NamedSharding(mesh, P(axis))
+    images = jax.device_put(images_host, data_sharding)
+    labels = jax.device_put(labels_host, data_sharding)
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+    batch_stats = jax.device_put(batch_stats, NamedSharding(mesh, P()))
+    opt_state = jax.device_put(opt_state, NamedSharding(mesh, P()))
+
+    for _ in range(args.num_warmup):
+        params, batch_stats, opt_state, loss = sharded_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, batch_stats, opt_state, loss = sharded_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    total_images = args.num_iters * args.batch_size * n
+    img_per_sec_per_chip = total_images / elapsed / n
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""horovod_tpu: a TPU-native distributed training framework with Horovod's
+capabilities (reference surveyed in SURVEY.md), built on jax/XLA.
+
+Five-line usage, mirroring the reference README (``/root/reference/README.rst``):
+
+    import horovod_tpu as hvd
+    hvd.init()
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01 * hvd.size()))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    # train under jax.jit / shard_map over hvd.mesh()
+
+Hot-path inversion (SURVEY.md §7): the reference injects a C++ background
+runtime between the framework and NCCL/MPI; here the XLA compiler schedules
+collectives natively over the ICI/DCN mesh. A dynamic-dispatch engine
+(fusion/negotiation/caching) exists for eager-mode parity.
+"""
+
+from . import runtime as _runtime
+from .runtime import (
+    AXIS_NAME,
+    NotInitializedError,
+    axis_name,
+    cross_rank,
+    cross_size,
+    devices,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_ranks,
+    local_size,
+    mesh,
+    process_count,
+    process_rank,
+    rank,
+    shutdown,
+    size,
+)
+from .ops import (
+    Adasum,
+    Average,
+    Compression,
+    Handle,
+    Max,
+    Min,
+    PerRank,
+    Product,
+    ReduceOp,
+    Sum,
+    adasum_allreduce,
+    allgather,
+    allgather_async,
+    allgather_object,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    broadcast_object,
+    grouped_allreduce,
+    join,
+    per_rank,
+    poll,
+    reducescatter,
+    synchronize,
+)
+from .process_sets import (
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
+from .optim import (
+    DistributedOptimizer,
+    allreduce_gradients_transform,
+    grad,
+    value_and_grad,
+)
+from .functions import (
+    broadcast_object as _bo,  # re-exported above via ops
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    broadcast_variables,
+)
+from .version import __version__
+
+# Torch-parity aliases (reference exposes in-place variants; jax arrays are
+# immutable so they alias the pure versions).
+allreduce_ = allreduce
+broadcast_ = broadcast
+
+__all__ = [
+    "AXIS_NAME", "NotInitializedError", "axis_name", "cross_rank",
+    "cross_size", "devices", "init", "is_homogeneous", "is_initialized",
+    "local_rank", "local_ranks", "local_size", "mesh", "process_count",
+    "process_rank", "rank", "shutdown", "size",
+    "Adasum", "Average", "Compression", "Handle", "Max", "Min", "PerRank",
+    "Product", "ReduceOp", "Sum", "adasum_allreduce", "allgather",
+    "allgather_async", "allgather_object", "allreduce", "allreduce_",
+    "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
+    "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce",
+    "join", "per_rank", "poll", "reducescatter", "synchronize",
+    "ProcessSet", "add_process_set", "global_process_set", "remove_process_set",
+    "DistributedOptimizer", "allreduce_gradients_transform", "grad",
+    "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
+    "broadcast_variables", "__version__",
+]

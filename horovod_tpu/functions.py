@@ -1,0 +1,50 @@
+"""State synchronization helpers.
+
+Rebuild of ``/root/reference/horovod/torch/functions.py`` (269 LoC:
+``broadcast_parameters`` / ``broadcast_optimizer_state`` / ``broadcast_object``)
+and ``/root/reference/horovod/tensorflow/functions.py`` (``broadcast_variables``).
+Reference examples call these at step 0 so every rank starts from rank 0's
+weights (``examples/pytorch/pytorch_mnist.py:220-221``).
+
+On TPU under single-controller SPMD, jax arrays are already globally
+consistent, so these matter for (a) process-set subsets, (b) multi-process
+host state divergence (RNG, python objects), and (c) elastic restarts —
+they broadcast through the same collective layer for full parity.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .ops import collectives
+from .process_sets import ProcessSet
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set: ProcessSet | None = None):
+    """Broadcast a pytree of arrays from ``root_rank`` to all ranks
+    (reference ``broadcast_parameters``, ``torch/functions.py``).
+    Returns the synchronized pytree."""
+    return jax.tree.map(
+        lambda x: collectives.broadcast(x, root_rank, process_set=process_set),
+        params)
+
+
+# TF-parity alias (reference ``broadcast_variables``, tensorflow/functions.py)
+broadcast_variables = broadcast_parameters
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set: ProcessSet | None = None):
+    """Broadcast optimizer state (reference ``broadcast_optimizer_state``).
+    optax states are array pytrees, so this is the same tree broadcast —
+    non-array leaves (step counts as python ints, None) pass through."""
+    def _bcast(x):
+        if hasattr(x, "dtype") and hasattr(x, "shape"):
+            return collectives.broadcast(x, root_rank, process_set=process_set)
+        return x
+    return jax.tree.map(_bcast, opt_state)
+
+
+broadcast_object = collectives.broadcast_object
+allgather_object = collectives.allgather_object

@@ -1,0 +1,7 @@
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from .transformer import TransformerConfig, TransformerLM, param_shardings
+
+__all__ = [
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+    "TransformerConfig", "TransformerLM", "param_shardings",
+]

@@ -1,0 +1,134 @@
+"""GPT-style Transformer LM, written for multi-axis mesh sharding.
+
+No reference equivalent (Horovod is model-agnostic); this is the flagship
+model for demonstrating the framework's tensor/sequence/data-parallel
+shardings beyond the reference's data-parallel scope (SURVEY.md §2.3).
+
+TPU-first: bfloat16 compute/fp32 params, head and MLP dims sized for the
+MXU, and a ``shardings()`` helper producing PartitionSpecs for a
+``('dp', 'tp')``(+ optional 'sp') mesh — Megatron-style column/row-parallel
+splits expressed as GSPMD sharding constraints, letting XLA insert the
+all-reduces over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.num_heads
+        dense = lambda name, features: nn.DenseGeneral(
+            features, axis=-1, name=name, dtype=cfg.dtype,
+            param_dtype=jnp.float32, use_bias=False)
+        # qkv: column-parallel (heads split over 'tp')
+        q = dense("q", (cfg.num_heads, head_dim))(x)
+        k = dense("k", (cfg.num_heads, head_dim))(x)
+        v = dense("v", (cfg.num_heads, head_dim))(x)
+        q = q / jnp.sqrt(head_dim).astype(cfg.dtype)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        seq = x.shape[1]
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        # output proj: row-parallel
+        return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), name="o",
+                               dtype=cfg.dtype, param_dtype=jnp.float32,
+                               use_bias=False)(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     use_bias=False, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
+                        use_bias=False, name="wo")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.cfg.dtype, param_dtype=jnp.float32)(x)
+        x = x + Attention(self.cfg, name="attn")(y)
+        y = nn.LayerNorm(dtype=self.cfg.dtype, param_dtype=jnp.float32)(x)
+        return x + MLP(self.cfg, name="mlp")(y)
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="pos_embed")(
+            jnp.arange(tokens.shape[1]))
+        x = x + pos[None]
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, use_bias=False,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def param_shardings(params, *, tp_axis: str = "tp"):
+    """PartitionSpec pytree for Megatron-style tensor parallelism:
+    column-parallel qkv/wi (split output dim over tp), row-parallel o/wo
+    (split input dim), embeddings split over vocab/d_ff-free dims."""
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", str(k)) for k in path]
+        joined = "/".join(str(n) for n in names)
+        nd = leaf.ndim
+        if "attn" in joined and any(f"/{p}/" in joined + "/" for p in ("q", "k", "v")):
+            # (d_model, heads, head_dim): split heads over tp
+            return P(None, tp_axis, None) if nd == 3 else P(None, tp_axis)
+        if "/o/" in joined + "/":
+            # (heads, head_dim, d_model): split heads over tp
+            return P(tp_axis, None, None) if nd == 3 else P(tp_axis, None)
+        if joined.endswith("wi/kernel"):
+            return P(None, tp_axis)
+        if joined.endswith("wo/kernel"):
+            return P(tp_axis, None)
+        if joined.endswith("lm_head/kernel"):
+            return P(None, tp_axis)
+        if joined.endswith("embed/embedding"):
+            return P(tp_axis, None)
+        return P(*([None] * nd))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

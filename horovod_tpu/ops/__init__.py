@@ -1,0 +1,33 @@
+from .reduce_ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum
+from .compression import Compression
+from .collectives import (
+    Handle,
+    PerRank,
+    allgather,
+    allgather_async,
+    allgather_object,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    broadcast_object,
+    grouped_allreduce,
+    join,
+    per_rank,
+    poll,
+    reducescatter,
+    synchronize,
+)
+from .adasum import adasum_allreduce
+
+__all__ = [
+    "Adasum", "Average", "Max", "Min", "Product", "ReduceOp", "Sum",
+    "Compression", "Handle", "PerRank", "allgather", "allgather_async",
+    "allgather_object", "allreduce", "allreduce_async", "alltoall",
+    "alltoall_async", "barrier", "broadcast", "broadcast_async",
+    "broadcast_object", "grouped_allreduce", "join", "per_rank", "poll",
+    "reducescatter", "synchronize", "adasum_allreduce",
+]

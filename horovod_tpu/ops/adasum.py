@@ -1,0 +1,98 @@
+"""Adasum: scale-invariant gradient combination.
+
+Rebuild of the reference's Adasum (``/root/reference/horovod/common/ops/adasum/adasum.h:194-342``):
+vector-halving distance-doubling (VHDD) recursive reduction where each level
+pairs ranks ``r`` and ``r ^ 2^level`` and combines their vectors *a*, *b* as
+
+    a' = (1 - a.b / (2 |a|^2)) a + (1 - a.b / (2 |b|^2)) b
+
+(the ``FusedPairwiseReduceWithComm`` math, ``adasum.h:248-342``), which keeps
+the magnitude of the combined update stable when gradients point the same
+way (scale invariance) and adds them when orthogonal.
+
+TPU-native mapping: the XOR-partner exchange becomes ``lax.ppermute`` over
+the mesh axis; the pairwise combine is a fused elementwise+reduction XLA
+program. The combine is symmetric, so both partners compute identical
+results locally — after log2(n) levels every rank holds the full Adasum
+reduction (no separate allgather leg needed, unlike the MPI p2p version
+``adasum_mpi.cc``).
+
+Accumulation note (SURVEY §7 hard part (d)): dot products and norms are
+accumulated in float32 even for bf16/fp16 inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import runtime
+from ..process_sets import ProcessSet, _resolve
+
+
+def _pairwise_combine(a, b):
+    """Scale-invariant pairwise combine (adasum.h:248-342)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.sum(af * bf)
+    na = jnp.sum(af * af)
+    nb = jnp.sum(bf * bf)
+    acoeff = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)), 1.0)
+    bcoeff = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)), 1.0)
+    out = acoeff * af + bcoeff * bf
+    return out.astype(a.dtype)
+
+
+def adasum_reduce(x, axis, groups=None):
+    """Traced-mode Adasum allreduce over mesh axis ``axis`` via a
+    ppermute XOR-partner tree. Requires a power-of-two axis size."""
+    if groups is not None:
+        raise NotImplementedError(
+            "Adasum over a process-set subset is not supported yet; "
+            "use the eager path (sub-mesh) or the global set.")
+    n = lax.axis_size(axis) if hasattr(lax, "axis_size") else None
+    if n is None:
+        n = lax.psum(1, axis)
+    n = int(n)
+    if n & (n - 1):
+        raise NotImplementedError(
+            f"Adasum requires a power-of-two rank count (got {n}); the "
+            "reference builds power-of-two reduction comms the same way "
+            "(adasum_mpi.cc).")
+    level = 1
+    while level < n:
+        perm = [(r, r ^ level) for r in range(n)]
+        partner = lax.ppermute(x, axis, perm)
+        x = _pairwise_combine(x, partner)
+        level <<= 1
+    return x
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_adasum_fn(mesh: Mesh, axis: str):
+    def inner(x):  # (1, ...) bundle shard
+        return adasum_reduce(x, axis)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+
+
+def adasum_allreduce(tensor, *, process_set: ProcessSet | None = None,
+                     axis_name=None):
+    """Adasum allreduce, eager or traced (reference op selection
+    ``operations.cc:161-162``; enqueue with ``ReduceOp.Adasum``)."""
+    from .collectives import PerRank, _as_bundle, _axis_is_bound, _resolve_axis
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    if _axis_is_bound(axis):
+        return adasum_reduce(tensor, axis, pset.axis_index_groups())
+    n = pset.size()
+    if n & (n - 1):
+        raise NotImplementedError(
+            f"Adasum requires a power-of-two rank count (got {n})")
+    bundle, _ = _as_bundle(tensor, pset)
+    out = _eager_adasum_fn(pset.mesh(), axis)(bundle)
+    return out[0]

@@ -1,0 +1,635 @@
+"""Collective operations over the rank mesh.
+
+TPU-native rebuild of the reference's collective op layer
+(``/root/reference/horovod/common/ops/collective_operations.h:38-308`` and the
+enqueue API ``EnqueueTensorAllreduce(s)/Allgather/Broadcast/Alltoall/Barrier``
+at ``/root/reference/horovod/common/operations.cc:1357-1795``), with the
+design inversion of SURVEY.md §7: the XLA compiler — not a background
+runtime thread — schedules collectives.
+
+Two execution modes:
+
+* **Traced mode** — the call happens inside user code already running under
+  ``jax.shard_map`` (or ``pmap``) with the runtime's mesh axis bound. The op
+  lowers directly to ``lax.psum``/``all_gather``/``all_to_all``/
+  ``psum_scatter``; XLA fuses and overlaps them (this replaces the
+  reference's fusion buffer + cycle machinery for the jit hot path).
+  Process-set subsets lower to ``axis_index_groups`` partitions.
+
+* **Eager mode** — the call happens on concrete arrays. Per-rank inputs are
+  carried in a :class:`PerRank` bundle (leading axis = ranks, sharded across
+  chips); the op runs a cached ``jit(shard_map(...))`` over the process
+  set's sub-mesh. A plain (unbundled) array is treated as the same value
+  contributed by every rank.
+
+Eager collectives return plain arrays when the result is identical on every
+rank (allreduce/allgather/broadcast) and :class:`PerRank` bundles when it
+differs (alltoall/reducescatter).
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import runtime
+from ..process_sets import ProcessSet, _resolve
+from .reduce_ops import ReduceOp, handle_average
+from ..utils import logging as hvd_logging
+
+
+class PerRank:
+    """Bundle of per-rank values: ``array[i]`` is rank *i*'s tensor (ranks
+    ordered by position in the process set). The eager-mode analog of "each
+    Horovod rank passes its local tensor"."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+    @property
+    def shape(self):
+        return self.array.shape
+
+    @property
+    def dtype(self):
+        return self.array.dtype
+
+    def __len__(self):
+        return self.array.shape[0]
+
+    def __getitem__(self, i):
+        return self.array[i]
+
+    def to_list(self):
+        return [self.array[i] for i in range(self.array.shape[0])]
+
+    def __repr__(self):
+        return f"PerRank(shape={tuple(self.array.shape)}, dtype={self.array.dtype})"
+
+
+def per_rank(values, process_set: ProcessSet | None = None) -> PerRank:
+    """Build a :class:`PerRank` bundle from a sequence of per-rank arrays
+    (or an array whose leading axis already indexes ranks), sharded one
+    slice per chip of the process set."""
+    pset = _resolve(process_set)
+    if isinstance(values, (list, tuple)):
+        arr = jnp.stack([jnp.asarray(v) for v in values])
+    else:
+        arr = jnp.asarray(values)
+    n = pset.size()
+    if arr.shape[0] != n:
+        raise ValueError(
+            f"per_rank leading axis {arr.shape[0]} != process set size {n}")
+    sharding = NamedSharding(pset.mesh(), P(runtime.axis_name()))
+    return PerRank(jax.device_put(arr, sharding))
+
+
+# ---------------------------------------------------------------------------
+# mode detection
+# ---------------------------------------------------------------------------
+
+try:
+    from jax.core import Tracer as _Tracer
+except (ImportError, AttributeError):  # pragma: no cover
+    from jax._src.core import Tracer as _Tracer
+
+
+def _contains_tracer(x) -> bool:
+    if isinstance(x, PerRank):
+        x = x.array
+    return isinstance(x, _Tracer)
+
+
+def _axis_is_bound(axis) -> bool:
+    """True when `axis` is a bound mapped axis (we are inside shard_map/pmap
+    traced code). Outside any such context ``lax.axis_index`` raises
+    NameError."""
+    try:
+        lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+    except TypeError:
+        return False
+
+
+def _resolve_axis(axis_name):
+    return runtime.axis_name() if axis_name is None else axis_name
+
+
+# ---------------------------------------------------------------------------
+# traced-mode primitives (shared by eager inners, which run traced under
+# shard_map over the sub-mesh with groups=None)
+# ---------------------------------------------------------------------------
+
+def _reduce(x, axis, op: ReduceOp, groups):
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis, axis_index_groups=groups)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis, axis_index_groups=groups)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis, axis_index_groups=groups)
+    if op == ReduceOp.PRODUCT:
+        if groups is not None:
+            raise NotImplementedError(
+                "PRODUCT over a process-set subset inside traced code is not "
+                "supported yet; use the eager path (sub-mesh).")
+        g = lax.all_gather(x, axis)
+        return jnp.prod(g, axis=0)
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_reduce
+        return adasum_reduce(x, axis, groups)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def _axis_denominator(x, axis, groups):
+    """Number of participants in this member's reduction group: the bound
+    axis size (NOT the world size — the user may reduce over a sub-axis of
+    a multi-dim mesh), or the group size under a process-set partition."""
+    return lax.psum(jnp.ones((), jnp.float32), axis, axis_index_groups=groups)
+
+
+def _allreduce_traced(x, axis, op, pre, post, groups):
+    if pre != 1.0:
+        x = x * pre
+    if op == ReduceOp.AVERAGE:
+        out = lax.psum(x, axis, axis_index_groups=groups)
+        out = out / _axis_denominator(x, axis, groups).astype(out.dtype)
+    else:
+        out = _reduce(x, axis, op, groups)
+    if post != 1.0:
+        out = out * post
+    return out
+
+
+def _allgather_traced(x, axis, groups, ranks, pset_size):
+    if groups is None:
+        return lax.all_gather(x, axis, tiled=True)
+    # Subset gather via one-hot placement + grouped psum (unequal
+    # axis_index_groups are legal for psum but not all_gather).
+    ranks_arr = jnp.array(ranks)
+    idx = lax.axis_index(axis)
+    pos = jnp.sum((ranks_arr < idx).astype(jnp.int32))
+    d0 = x.shape[0]
+    out = jnp.zeros((pset_size * d0,) + x.shape[1:], dtype=x.dtype)
+    out = lax.dynamic_update_slice(
+        out, x, (pos * d0,) + (0,) * (x.ndim - 1))
+    return lax.psum(out, axis, axis_index_groups=groups)
+
+
+def _broadcast_traced(x, axis, root_rank, groups, ranks):
+    idx = lax.axis_index(axis)
+    orig_dtype = x.dtype
+    xv = x.astype(jnp.int8) if orig_dtype == jnp.bool_ else x
+    masked = jnp.where(idx == root_rank, xv, jnp.zeros_like(xv))
+    out = lax.psum(masked, axis, axis_index_groups=groups)
+    if groups is not None:
+        member = jnp.isin(idx, jnp.array(ranks))
+        out = jnp.where(member, out, xv)
+    if orig_dtype == jnp.bool_:
+        out = out.astype(jnp.bool_)
+    return out
+
+
+def _alltoall_traced(x, axis, groups):
+    if groups is not None:
+        raise NotImplementedError(
+            "alltoall over a process-set subset inside traced code is not "
+            "supported yet; use the eager path (sub-mesh).")
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def _reducescatter_traced(x, axis, op, post, groups):
+    if groups is not None:
+        raise NotImplementedError(
+            "reducescatter over a process-set subset inside traced code is "
+            "not supported yet; use the eager path (sub-mesh).")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise NotImplementedError("reducescatter supports SUM/AVERAGE")
+    out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / _axis_denominator(x, axis, groups).astype(out.dtype)
+    if post != 1.0:
+        out = out * post
+    return out
+
+
+# ---------------------------------------------------------------------------
+# eager machinery: cached jitted shard_maps over the process-set sub-mesh
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _eager_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float, post: float):
+    def inner(x):  # x: (1, ...) bundle shard
+        return _allreduce_traced(x, axis, op, pre, post, None)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_grouped_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
+                                post: float, num_bufs: int):
+    def inner(*xs):
+        return tuple(_allreduce_traced(x, axis, op, pre, post, None) for x in xs)
+    specs = tuple(P(axis) for _ in range(num_bufs))
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=specs, out_specs=specs, check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_allgather_fn(mesh: Mesh, axis: str):
+    def inner(x):  # (1, d0, ...) -> (n*d0, ...) replicated
+        return lax.all_gather(x[0], axis, tiled=True)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_broadcast_fn(mesh: Mesh, axis: str, root_pos: int):
+    def inner(x):  # (1, ...) -> (...) replicated
+        return _broadcast_traced(x[0], axis, root_pos, None, None)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_alltoall_fn(mesh: Mesh, axis: str):
+    def inner(x):  # (1, s, ...) -> (s, ...) per-rank
+        return _alltoall_traced(x[0], axis, None)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_reducescatter_fn(mesh: Mesh, axis: str, op: ReduceOp, post: float):
+    def inner(x):  # (1, d0, ...) -> (d0/n, ...) per-rank
+        return _reducescatter_traced(x[0], axis, op, post, None)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+
+
+def _as_bundle(tensor, pset: ProcessSet):
+    """Canonicalize eager input to a (pset.size, ...) bundle array.
+
+    Returns (bundle, was_bundled)."""
+    n = pset.size()
+    if isinstance(tensor, PerRank):
+        arr = tensor.array
+        if arr.shape[0] != n:
+            raise ValueError(
+                f"PerRank bundle leading axis {arr.shape[0]} != process set size {n}")
+        return arr, True
+    arr = jnp.asarray(tensor)
+    return jnp.broadcast_to(arr[None], (n,) + arr.shape), False
+
+
+def _gspmd_passthrough_check(op: ReduceOp, name: str) -> None:
+    """Inside plain jit/pjit only the gradient-reduction ops (SUM/AVERAGE)
+    are equivalent to the partitioner's own reduction; anything else has no
+    GSPMD meaning and must run under shard_map."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise RuntimeError(
+            f"{name}(op={op.name}) was called inside jit/pjit without a "
+            "bound mesh axis; only SUM/AVERAGE have GSPMD passthrough "
+            "semantics. Run it under jax.shard_map over hvd.mesh().")
+    hvd_logging.debug(
+        "%s inside jit/pjit without a bound axis: GSPMD passthrough "
+        "(gradients are already globally reduced by the partitioner)", name)
+
+
+def _check_op_dtype(op: ReduceOp, dtype):
+    if op == ReduceOp.AVERAGE and jnp.issubdtype(dtype, jnp.integer):
+        raise TypeError(
+            "ReduceOp.AVERAGE is not supported for integer tensors "
+            "(matches the reference's restriction); use SUM.")
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
+              process_set: ProcessSet | None = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              name: str | None = None, axis_name=None):
+    """Allreduce (reference ``hvd.allreduce``; enqueue path
+    ``operations.cc:1357-1512``). AVERAGE lowers to SUM + postscale 1/n
+    (``operations.cc:1408-1416``)."""
+    del name
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    _check_op_dtype(op, jnp.result_type(tensor if not isinstance(tensor, PerRank)
+                                       else tensor.array))
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce
+        return adasum_allreduce(tensor, process_set=pset, axis_name=axis)
+    if _axis_is_bound(axis):
+        return _allreduce_traced(tensor, axis, op, prescale_factor,
+                                 postscale_factor, pset.axis_index_groups())
+    if _contains_tracer(tensor):
+        # Inside jit/pjit with no named axis: GSPMD semantics — gradients of
+        # a globally-sharded computation are already globally reduced by
+        # XLA's partitioner, so the allreduce is the identity (the design
+        # inversion of SURVEY.md §7; the reference's XLA bridge
+        # xla_mpi_ops.cc:165-260 calls back into the runtime instead).
+        # Only the gradient-reduction ops have this equivalence.
+        _gspmd_passthrough_check(op, "allreduce")
+        scale = prescale_factor * postscale_factor
+        return tensor if scale == 1.0 else tensor * scale
+    lowered_op, post = handle_average(op, pset.size(), postscale_factor)
+    bundle, _ = _as_bundle(tensor, pset)
+    fn = _eager_allreduce_fn(pset.mesh(), axis, lowered_op,
+                             float(prescale_factor), float(post))
+    return fn(bundle)[0]
+
+
+def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
+                      process_set: ProcessSet | None = None,
+                      prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                      name: str | None = None, axis_name=None):
+    """Fused allreduce of a tensor list (reference ``grouped_allreduce``,
+    ``EnqueueTensorAllreduces`` with a group at ``operations.cc:1384-1512``).
+
+    Eager mode performs explicit tensor fusion: tensors are flattened and
+    concatenated per dtype into single wire buffers (the XLA analog of the
+    reference's fusion buffer, ``fusion_buffer_manager.h:30-50``), reduced
+    in one compiled program, then split back.
+    """
+    del name
+    if not tensors:
+        return []
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    for t in tensors:
+        _check_op_dtype(op, jnp.result_type(t if not isinstance(t, PerRank) else t.array))
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce
+        return [adasum_allreduce(t, process_set=pset, axis_name=axis) for t in tensors]
+
+    if _axis_is_bound(axis):
+        groups = pset.axis_index_groups()
+        return [_allreduce_traced(t, axis, op, prescale_factor,
+                                  postscale_factor, groups)
+                for t in tensors]
+    if any(_contains_tracer(t) for t in tensors):
+        # GSPMD passthrough (see allreduce above).
+        _gspmd_passthrough_check(op, "grouped_allreduce")
+        scale = prescale_factor * postscale_factor
+        return list(tensors) if scale == 1.0 else [t * scale for t in tensors]
+    lowered_op, post = handle_average(op, pset.size(), postscale_factor)
+
+    # --- eager fusion path ---
+    n = pset.size()
+    bundles = [_as_bundle(t, pset)[0] for t in tensors]
+    by_dtype: dict = {}
+    for i, b in enumerate(bundles):
+        by_dtype.setdefault(jnp.result_type(b), []).append(i)
+    fused_inputs, metas = [], []
+    for dt, idxs in by_dtype.items():
+        flat = [bundles[i].reshape(n, -1) for i in idxs]
+        fused_inputs.append(jnp.concatenate(flat, axis=1))
+        metas.append((dt, idxs, [bundles[i].shape[1:] for i in idxs]))
+    fn = _eager_grouped_allreduce_fn(pset.mesh(), axis, lowered_op,
+                                     float(prescale_factor), float(post),
+                                     len(fused_inputs))
+    fused_outputs = fn(*fused_inputs)
+    results: list = [None] * len(tensors)
+    for buf, (dt, idxs, shapes) in zip(fused_outputs, metas):
+        offset = 0
+        vec = buf[0]  # identical on every rank
+        for i, shp in zip(idxs, shapes):
+            sz = int(np.prod(shp)) if shp else 1
+            results[i] = vec[offset:offset + sz].reshape(shp)
+            offset += sz
+    return results
+
+
+def allgather(tensor, *, process_set: ProcessSet | None = None,
+              name: str | None = None, axis_name=None):
+    """Allgather: concatenate per-rank tensors along dim 0 (reference
+    ``hvd.allgather``; ``EnqueueTensorAllgather`` at ``operations.cc:1529``,
+    displacement math at ``collective_operations.h:143-178``).
+
+    Traced mode requires uniform shapes across ranks (SPMD static shapes);
+    the reference's ragged first dimension is supported via
+    :func:`allgather_object` or explicit padding.
+    """
+    del name
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    if _axis_is_bound(axis):
+        return _allgather_traced(tensor, axis, pset.axis_index_groups(),
+                                 pset.ranks, pset.size())
+    if _contains_tracer(tensor):
+        raise RuntimeError(
+            "allgather() was called inside jit/pjit without a bound mesh axis. "
+            "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
+            "so the op can lower to an XLA collective.")
+    bundle, _ = _as_bundle(tensor, pset)
+    if bundle.ndim == 1:  # scalars per rank: gather to a vector
+        bundle = bundle[:, None]
+        return _eager_allgather_fn(pset.mesh(), axis)(bundle).reshape(-1)
+    return _eager_allgather_fn(pset.mesh(), axis)(bundle)
+
+
+def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
+              name: str | None = None, axis_name=None):
+    """Broadcast from ``root_rank`` (a *global* rank, as in the reference's
+    ``hvd.broadcast``; ``operations.cc:1568``)."""
+    del name
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    if root_rank not in pset.ranks:
+        raise ValueError(f"root_rank {root_rank} not in process set {pset.ranks}")
+    if _axis_is_bound(axis):
+        return _broadcast_traced(tensor, axis, root_rank,
+                                 pset.axis_index_groups(), pset.ranks)
+    if _contains_tracer(tensor):
+        raise RuntimeError(
+            "broadcast() was called inside jit/pjit without a bound mesh axis. "
+            "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
+            "so the op can lower to an XLA collective.")
+    bundle, _ = _as_bundle(tensor, pset)
+    root_pos = pset.ranks.index(root_rank)
+    return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
+
+
+def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
+             name: str | None = None, axis_name=None):
+    """All-to-all along dim 0 (reference ``hvd.alltoall``,
+    ``operations.cc:1642-1727``). Equal splits only for now: rank *i*'s
+    j-th chunk of ``size`` equal chunks goes to rank *j* (uneven ``splits``
+    land with the dynamic engine)."""
+    del name
+    if splits is not None:
+        raise NotImplementedError(
+            "uneven alltoall splits are not supported yet; pass tensors with "
+            "dim0 divisible by the process-set size")
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    if _axis_is_bound(axis):
+        return _alltoall_traced(tensor, axis, pset.axis_index_groups())
+    if _contains_tracer(tensor):
+        raise RuntimeError(
+            "alltoall() was called inside jit/pjit without a bound mesh axis. "
+            "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
+            "so the op can lower to an XLA collective.")
+    bundle, _ = _as_bundle(tensor, pset)
+    n = pset.size()
+    if bundle.shape[1] % n != 0:
+        raise ValueError(f"alltoall dim0 ({bundle.shape[1]}) must be divisible "
+                         f"by process set size ({n})")
+    out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
+    return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
+
+
+def reducescatter(tensor, *, op: ReduceOp = ReduceOp.SUM,
+                  process_set: ProcessSet | None = None,
+                  name: str | None = None, axis_name=None):
+    """Reduce-scatter along dim 0: each rank receives one reduced chunk."""
+    del name
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    _check_op_dtype(op, jnp.result_type(tensor if not isinstance(tensor, PerRank)
+                                       else tensor.array))
+    if _axis_is_bound(axis):
+        return _reducescatter_traced(tensor, axis, op, 1.0,
+                                     pset.axis_index_groups())
+    lowered_op, post = handle_average(op, pset.size(), 1.0)
+    if _contains_tracer(tensor):
+        raise RuntimeError(
+            "reducescatter() was called inside jit/pjit without a bound mesh axis. "
+            "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
+            "so the op can lower to an XLA collective.")
+    bundle, _ = _as_bundle(tensor, pset)
+    n = pset.size()
+    if bundle.shape[1] % n != 0:
+        raise ValueError(f"reducescatter dim0 ({bundle.shape[1]}) must be "
+                         f"divisible by process set size ({n})")
+    out = _eager_reducescatter_fn(pset.mesh(), axis, lowered_op, float(post))(bundle)
+    return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
+
+
+def barrier(*, process_set: ProcessSet | None = None, axis_name=None):
+    """Block until every rank reaches the barrier (reference ``hvd.barrier``,
+    ``operations.cc:1763-1795``). Under SPMD a device barrier is a tiny
+    psum that we block on."""
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    if _axis_is_bound(axis):
+        return  # traced code is synchronous by construction
+    fn = _eager_allreduce_fn(pset.mesh(), axis, ReduceOp.SUM, 1.0, 1.0)
+    jax.block_until_ready(fn(jnp.zeros((pset.size(), 1), jnp.int32)))
+
+
+def join() -> int:
+    """Reference ``hvd.join`` (``operations.cc:1729-1761``) lets ranks with
+    uneven data drop out of collectives. Under SPMD every chip executes the
+    same program, so uneven participation is expressed by masking/padding in
+    the input pipeline instead; ``join`` degenerates to a barrier. Returns
+    the last joined rank (== size-1) for API parity."""
+    barrier()
+    return runtime.size() - 1
+
+
+# ---------------------------------------------------------------------------
+# async handles (reference torch mpi_ops.py:914-953 poll/synchronize)
+# ---------------------------------------------------------------------------
+
+class Handle:
+    """Completion handle for *_async ops. JAX dispatch is already
+    asynchronous; the handle wraps the in-flight result."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self, result):
+        self._result = result
+
+    def poll(self) -> bool:
+        leaves = jax.tree.leaves(
+            self._result.array if isinstance(self._result, PerRank) else self._result)
+        return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+
+    def synchronize(self):
+        jax.block_until_ready(
+            self._result.array if isinstance(self._result, PerRank) else self._result)
+        return self._result
+
+
+def allreduce_async(tensor, **kw) -> Handle:
+    return Handle(allreduce(tensor, **kw))
+
+
+def allgather_async(tensor, **kw) -> Handle:
+    return Handle(allgather(tensor, **kw))
+
+
+def broadcast_async(tensor, root_rank, **kw) -> Handle:
+    return Handle(broadcast(tensor, root_rank, **kw))
+
+
+def alltoall_async(tensor, splits=None, **kw) -> Handle:
+    return Handle(alltoall(tensor, splits, **kw))
+
+
+def poll(handle: Handle) -> bool:
+    return handle.poll()
+
+
+def synchronize(handle: Handle):
+    return handle.synchronize()
+
+
+# ---------------------------------------------------------------------------
+# object collectives (reference torch/functions.py broadcast_object /
+# allgather_object)
+# ---------------------------------------------------------------------------
+
+def broadcast_object(obj, root_rank: int = 0, *, name: str | None = None):
+    """Broadcast a picklable object from the root *process* (reference
+    ``broadcast_object``, ``torch/functions.py``). Objects live per
+    controller process, so this is a process-level broadcast."""
+    del name
+    if runtime.process_count() <= 1:
+        return obj
+    from jax.experimental import multihost_utils
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(
+        np.array(len(payload), np.int64),
+        is_source=runtime.process_rank() == root_rank)
+    buf = np.zeros(int(size), np.uint8)
+    if runtime.process_rank() == root_rank:
+        buf[:] = payload
+    out = multihost_utils.broadcast_one_to_all(
+        buf, is_source=runtime.process_rank() == root_rank)
+    return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj, *, name: str | None = None) -> list:
+    """Gather a picklable object from every *process* (reference
+    ``allgather_object``)."""
+    del name
+    if runtime.process_count() <= 1:
+        return [obj]
+    return [pickle.loads(b) for b in _gather_bytes(pickle.dumps(obj))]
+
+
+def _gather_bytes(data: bytes) -> list:
+    from jax.experimental import multihost_utils
+    n = runtime.process_count()
+    size = np.array(len(data), np.int64)
+    sizes = multihost_utils.process_allgather(size)
+    max_size = int(np.max(sizes))
+    buf = np.zeros(max_size, np.uint8)
+    buf[: len(data)] = np.frombuffer(data, np.uint8)
+    bufs = multihost_utils.process_allgather(buf)
+    return [bufs[i, : int(sizes[i])].tobytes() for i in range(n)]

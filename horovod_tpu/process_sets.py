@@ -1,0 +1,182 @@
+"""Process sets: collectives over subsets of ranks.
+
+TPU-native equivalent of the reference's ``ProcessSet``/``ProcessSetTable``
+(``/root/reference/horovod/common/process_set.h:26-171``) and the Python API
+(``/root/reference/horovod/common/process_sets.py``). A process set maps to
+
+* a **sub-mesh** over its member chips (eager path — XLA emits ICI-local
+  collectives for the subset), and
+* an ``axis_index_groups`` partition of the global mesh axis (traced path —
+  every chip participates in the SPMD program; non-members reduce within
+  singleton groups, mirroring how non-member ranks simply don't contribute).
+
+Dynamic registration/removal mirrors ``process_set.h:89-171`` (ids with a
+free-list; gated on ``HVD_DYNAMIC_PROCESS_SETS`` like the reference gates on
+``HOROVOD_DYNAMIC_PROCESS_SETS``, ``operations.cc:606-607``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from . import runtime
+
+
+class ProcessSet:
+    """A subset of global ranks over which collectives run.
+
+    Mirrors ``horovod.ProcessSet`` (``process_sets.py:20-80``): created with
+    a rank list, bound to an id once registered.
+    """
+
+    def __init__(self, ranks: Sequence[int] | None = None):
+        self.process_set_id: int | None = None
+        self._ranks: list[int] | None = sorted(ranks) if ranks is not None else None
+        self._mesh: Mesh | None = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def ranks(self) -> list[int]:
+        if self._ranks is None:
+            return list(range(runtime.size()))
+        return list(self._ranks)
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self, global_rank: int | None = None) -> bool:
+        """Whether ``global_rank`` (default: this process's representative
+        rank) belongs to the set (reference ``process_set.included()``)."""
+        r = runtime.rank() if global_rank is None else global_rank
+        return r in set(self.ranks)
+
+    def rank(self, global_rank: int | None = None) -> int:
+        """Rank *within* the set of a global rank (−1 if not included)."""
+        r = runtime.rank() if global_rank is None else global_rank
+        try:
+            return self.ranks.index(r)
+        except ValueError:
+            return -1
+
+    # -- mesh machinery ----------------------------------------------------
+    @property
+    def is_global(self) -> bool:
+        return self.size() == runtime.size() and self.ranks == list(range(runtime.size()))
+
+    def mesh(self) -> Mesh:
+        """Sub-mesh over member chips, axis name == global axis name."""
+        if self.is_global:
+            return runtime.mesh()
+        if self._mesh is None:
+            devs = runtime.devices()
+            members = [devs[r] for r in self.ranks]
+            self._mesh = Mesh(np.array(members), (runtime.axis_name(),))
+        return self._mesh
+
+    def axis_index_groups(self) -> list[list[int]] | None:
+        """Partition of the global axis for traced-mode collectives.
+
+        Members form one group; every non-member is a singleton group (the
+        partition must cover the axis). ``None`` for the global set (lets
+        XLA use the plain collective).
+        """
+        if self.is_global:
+            return None
+        member = set(self.ranks)
+        groups = [list(self.ranks)]
+        groups.extend([r] for r in range(runtime.size()) if r not in member)
+        return groups
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+class ProcessSetTable:
+    """Id-keyed registry with a free-list, mirroring
+    ``ProcessSetTable`` (``process_set.h:89-171``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: dict[int, ProcessSet] = {}
+        self._next_id = 0
+        self._free_ids: list[int] = []
+        self.dynamic_enabled = False
+
+    def initialize_global(self, world_size: int) -> ProcessSet:
+        ps = ProcessSet(list(range(world_size)))
+        ps.process_set_id = 0
+        with self._lock:
+            self._table[0] = ps
+            self._next_id = 1
+        return ps
+
+    def add(self, ranks: Sequence[int], force: bool = False) -> ProcessSet:
+        if not force and not self.dynamic_enabled:
+            raise RuntimeError(
+                "Dynamic process sets are disabled; set HVD_DYNAMIC_PROCESS_SETS=1 "
+                "or pass process_sets to hvd.init() (reference gates identically, "
+                "operations.cc:606-607).")
+        ranks = sorted(set(ranks))
+        world = runtime.size()
+        for r in ranks:
+            if not 0 <= r < world:
+                raise ValueError(f"rank {r} out of range [0, {world})")
+        with self._lock:
+            for ps in self._table.values():
+                if ps.ranks == list(ranks):
+                    return ps  # reference dedups identical sets
+            ps = ProcessSet(ranks)
+            if self._free_ids:
+                ps.process_set_id = self._free_ids.pop(0)
+            else:
+                ps.process_set_id = self._next_id
+                self._next_id += 1
+            self._table[ps.process_set_id] = ps
+            return ps
+
+    def remove(self, ps: ProcessSet) -> None:
+        if ps.process_set_id in (None, 0):
+            raise ValueError("cannot remove the global process set (id 0)")
+        with self._lock:
+            if ps.process_set_id in self._table:
+                del self._table[ps.process_set_id]
+                self._free_ids.append(ps.process_set_id)
+                self._free_ids.sort()
+            ps.process_set_id = None
+
+    def get(self, ps_id: int) -> ProcessSet:
+        with self._lock:
+            return self._table[ps_id]
+
+    def ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._table)
+
+
+# --- module-level parity API (process_sets.py in the reference) -----------
+
+#: The always-present set of all ranks (id 0).
+global_process_set = ProcessSet()
+global_process_set.process_set_id = 0
+
+
+def _resolve(process_set: ProcessSet | None) -> ProcessSet:
+    return global_process_set if process_set is None else process_set
+
+
+def add_process_set(process_set: ProcessSet | Sequence[int]) -> ProcessSet:
+    """Register a new process set (reference ``add_process_set``,
+    ``process_sets.py:95-130``)."""
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(list(process_set))
+    registered = runtime.process_set_table().add(process_set.ranks)
+    process_set.process_set_id = registered.process_set_id
+    return registered
+
+
+def remove_process_set(process_set: ProcessSet) -> None:
+    runtime.process_set_table().remove(process_set)

@@ -1,0 +1,261 @@
+"""Process-wide runtime state: device mesh, ranks, process sets.
+
+TPU-native replacement for the reference's ``HorovodGlobalState`` singleton +
+init path (``/root/reference/horovod/common/global_state.h:39-126``,
+``InitializeHorovodOnce`` at ``/root/reference/horovod/common/operations.cc:811-864``)
+and the Python facade ``HorovodBasics``
+(``/root/reference/horovod/common/basics.py:48-146,373-468``).
+
+Design inversion (SURVEY.md §7): there is no background negotiation thread.
+Under SPMD the program order of collectives is identical on every rank by
+construction, so init reduces to (a) optional ``jax.distributed.initialize``
+rendezvous, (b) building a rank-ordered global ``jax.sharding.Mesh``, and
+(c) registering the global process set. A *rank* is a TPU chip (device), not
+a host process: one controller process drives ``local_size`` chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .utils import envs
+from .utils import logging as hvd_logging
+
+# The canonical mesh axis name for the flat data-parallel "rank" axis.
+AXIS_NAME = "hvd"
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when the API is used before ``hvd.init()`` (reference raises
+    from ``CheckInitialized``, ``operations.cc:904-910``)."""
+
+
+@dataclasses.dataclass
+class _RuntimeState:
+    devices: list  # rank-ordered global device list; rank == index
+    mesh: Mesh  # 1-D mesh over `devices` with axis AXIS_NAME
+    axis_name: str
+    process_index: int
+    process_count: int
+    local_ranks: list  # global ranks owned by this process
+    process_set_table: Any  # ProcessSetTable (import cycle avoided)
+
+
+_state: _RuntimeState | None = None
+_lock = threading.Lock()
+
+
+def _rank_ordered_devices(devices=None):
+    """Global devices ordered so rank = process-major, local-minor.
+
+    Mirrors the reference rank layout where ranks are contiguous per host
+    (``gloo_run.py:65-101`` seeds HOROVOD_RANK host-major)."""
+    devs = list(devices if devices is not None else jax.devices())
+    devs.sort(key=lambda d: (d.process_index, d.id))
+    return devs
+
+
+def init(
+    comm: Sequence[int] | None = None,
+    process_sets: Sequence[Sequence[int]] | str | None = None,
+    *,
+    devices=None,
+    axis_name: str = AXIS_NAME,
+) -> None:
+    """Initialize the runtime (reference: ``hvd.init`` → ``horovod_init``,
+    ``operations.cc:889-899``).
+
+    Args:
+      comm: optional list of global ranks forming the *global* process set
+        (reference accepts a rank list at ``basics.py:48-146``). Default: all.
+      process_sets: optional list of rank-lists to register as additional
+        process sets at init time, or the string ``"dynamic"`` to enable
+        dynamic registration (reference gates this on
+        ``HOROVOD_DYNAMIC_PROCESS_SETS``, ``operations.cc:606-607``).
+      devices: explicit device list (testing hook).
+      axis_name: mesh axis name used by every collective.
+    """
+    global _state
+    with _lock:
+        if _state is not None:
+            hvd_logging.debug("init() called twice; ignoring")
+            return
+
+        _maybe_distributed_init()
+
+        devs = _rank_ordered_devices(devices)
+        if comm is not None:
+            devs = [devs[r] for r in comm]
+        mesh = Mesh(np.array(devs), (axis_name,))
+
+        proc_index = jax.process_index()
+        local_ranks = [i for i, d in enumerate(devs) if d.process_index == proc_index]
+
+        from .process_sets import ProcessSetTable  # deferred: avoids cycle
+
+        table = ProcessSetTable()
+        _state = _RuntimeState(
+            devices=devs,
+            mesh=mesh,
+            axis_name=axis_name,
+            process_index=proc_index,
+            process_count=jax.process_count(),
+            local_ranks=local_ranks,
+            process_set_table=table,
+        )
+        table.initialize_global(len(devs))
+
+        dynamic = process_sets == "dynamic" or envs.get_bool(envs.DYNAMIC_PROCESS_SETS)
+        table.dynamic_enabled = dynamic
+        if process_sets and process_sets != "dynamic":
+            for ranks in process_sets:
+                table.add(list(ranks), force=True)
+
+        hvd_logging.info(
+            "initialized: %d chips across %d processes (this=%d, local=%s)",
+            len(devs), _state.process_count, proc_index, local_ranks,
+        )
+
+
+def _distributed_client_active() -> bool:
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:  # pragma: no cover - private API moved
+        return False
+
+
+def _maybe_distributed_init() -> None:
+    """Bootstrap ``jax.distributed`` from launcher-seeded env, the analog of
+    the reference rendezvous (``GlooContext::Initialize`` reading
+    ``HOROVOD_GLOO_RENDEZVOUS_ADDR``, ``gloo_context.h:29-42``).
+
+    NOTE: must run before anything touches the XLA backend — we avoid any
+    jax query here and check env + the distributed client state only.
+    """
+    addr = envs.get(envs.COORDINATOR_ADDR)
+    num_proc = envs.get_int(envs.NUM_PROCESSES, 1)
+    if addr is None or num_proc <= 1 or _distributed_client_active():
+        return
+    port = envs.get(envs.COORDINATOR_PORT, "9778")
+    proc_id = envs.get_int(envs.PROCESS_ID, 0)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=num_proc,
+            process_id=proc_id,
+        )
+        hvd_logging.info("jax.distributed initialized: process %d/%d via %s:%s",
+                         proc_id, num_proc, addr, port)
+    except RuntimeError as e:
+        # Either the backend was already initialized by earlier user code
+        # (jax.distributed must come first) or the coordinator is
+        # unreachable. Degrading silently to single-host would run
+        # unsynchronized training, so shout.
+        hvd_logging.error(
+            "jax.distributed.initialize failed (%s). This process will run "
+            "as a single-host world of %d local chips. Call hvd.init() "
+            "before any other jax API, or pre-initialize jax.distributed "
+            "yourself.", e, len(jax.local_devices()))
+
+
+def shutdown() -> None:
+    """Tear down the runtime (reference ``horovod_shutdown``,
+    ``operations.cc:926-942``)."""
+    global _state
+    with _lock:
+        _state = None
+
+
+def is_initialized() -> bool:
+    return _state is not None
+
+
+def _get() -> _RuntimeState:
+    if _state is None:
+        raise NotInitializedError(
+            "horovod_tpu has not been initialized; call hvd.init() first.")
+    return _state
+
+
+# --- rank/size queries (reference C API: operations.cc:944-1030) ----------
+
+def size() -> int:
+    """Total number of chips (== Horovod world size when 1 GPU per process)."""
+    return len(_get().devices)
+
+
+def local_size() -> int:
+    """Chips driven by this controller process."""
+    return len(_get().local_ranks)
+
+
+def rank() -> int:
+    """Representative global rank of this process: its first local chip.
+
+    Under SPMD one process drives many chips; inside traced code use
+    :func:`axis_rank` for the per-chip rank.
+    """
+    st = _get()
+    return st.local_ranks[0] if st.local_ranks else 0
+
+
+def local_rank() -> int:
+    # The representative rank (first local chip) is by definition local
+    # index 0 within this process.
+    _get()
+    return 0
+
+
+def cross_rank() -> int:
+    """Host index (reference cross-communicator rank, ``common.h:166-170``)."""
+    return _get().process_index
+
+
+def cross_size() -> int:
+    return _get().process_count
+
+
+def process_rank() -> int:
+    return _get().process_index
+
+
+def process_count() -> int:
+    return _get().process_count
+
+
+def is_homogeneous() -> bool:
+    """True when every process drives the same number of chips
+    (reference ``horovod_is_homogeneous``, ``operations.cc:1013-1017``)."""
+    st = _get()
+    counts = {}
+    for d in st.devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(set(counts.values())) <= 1
+
+
+def mesh() -> Mesh:
+    """The global 1-D rank mesh."""
+    return _get().mesh
+
+
+def axis_name() -> str:
+    return _get().axis_name
+
+
+def devices() -> list:
+    return list(_get().devices)
+
+
+def process_set_table():
+    return _get().process_set_table
+
+
+def local_ranks() -> list:
+    return list(_get().local_ranks)

@@ -1,0 +1,3 @@
+from . import envs, logging
+
+__all__ = ["envs", "logging"]

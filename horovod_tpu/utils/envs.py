@@ -1,0 +1,108 @@
+"""Environment-variable knob surface.
+
+TPU-native rebuild of the reference's env config system (knob list at
+``/root/reference/horovod/common/common.h:107-140``, parsed in
+``/root/reference/horovod/common/utils/env_parser.cc`` and
+``BackgroundThreadLoop`` at ``/root/reference/horovod/common/operations.cc:436-607``).
+
+All knobs use the ``HVD_`` prefix; the reference's ``HOROVOD_`` spellings are
+accepted as fallbacks so existing user scripts keep working.
+"""
+
+from __future__ import annotations
+
+import os
+
+# --- knob names (HVD_*; HOROVOD_* accepted as fallback) -------------------
+FUSION_THRESHOLD = "FUSION_THRESHOLD"  # bytes; reference default 128 MB (operations.cc:491-496)
+CYCLE_TIME = "CYCLE_TIME"  # ms; reference default 1 ms (operations.cc:499-506)
+CACHE_CAPACITY = "CACHE_CAPACITY"  # reference default 1024 (global_state.h:89)
+TIMELINE = "TIMELINE"  # trace output path (operations.cc:466-488)
+TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"
+AUTOTUNE = "AUTOTUNE"
+AUTOTUNE_LOG = "AUTOTUNE_LOG"
+AUTOTUNE_WARMUP_SAMPLES = "AUTOTUNE_WARMUP_SAMPLES"
+AUTOTUNE_STEPS_PER_SAMPLE = "AUTOTUNE_STEPS_PER_SAMPLE"
+AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+LOG_LEVEL = "LOG_LEVEL"
+LOG_TIMESTAMP = "LOG_TIMESTAMP"
+STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
+STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"  # reference warns at 60 s (stall_inspector.h:78)
+STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
+HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"
+HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
+BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
+DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
+ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
+GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"
+
+# rendezvous / launcher env seeded by `hvdrun` (reference:
+# HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
+RANK = "RANK"
+SIZE = "SIZE"
+LOCAL_RANK = "LOCAL_RANK"
+LOCAL_SIZE = "LOCAL_SIZE"
+CROSS_RANK = "CROSS_RANK"
+CROSS_SIZE = "CROSS_SIZE"
+COORDINATOR_ADDR = "COORDINATOR_ADDR"
+COORDINATOR_PORT = "COORDINATOR_PORT"
+NUM_PROCESSES = "NUM_PROCESSES"
+PROCESS_ID = "PROCESS_ID"
+
+_PREFIXES = ("HVD_", "HOROVOD_")
+
+
+def get(name: str, default: str | None = None) -> str | None:
+    """Look up knob ``name`` under the HVD_/HOROVOD_ prefixes."""
+    for prefix in _PREFIXES:
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    val = get(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(name: str, default: int) -> int:
+    val = get(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    val = get(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+# Defaults mirrored from the reference (operations.cc:491-506, global_state.h:89).
+DEFAULT_FUSION_THRESHOLD_BYTES = 128 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECONDS = 60.0
+
+
+def fusion_threshold_bytes() -> int:
+    return get_int(FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
+
+
+def cycle_time_ms() -> float:
+    return get_float(CYCLE_TIME, DEFAULT_CYCLE_TIME_MS)
+
+
+def cache_capacity() -> int:
+    return get_int(CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)

@@ -1,0 +1,222 @@
+"""Collective op tests, modeled on the reference's op×dtype×mode matrix
+(``test/parallel/test_tensorflow.py`` / ``test_torch.py`` — allreduce
+sum/average/min/max, allgather, broadcast, alltoall, grouped ops, barrier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+N = 8
+
+
+def _rank_values(shape=(4,), dtype=jnp.float32, mult=1.0):
+    """values[i] = (i+1) * mult * ones(shape)"""
+    return [jnp.full(shape, (i + 1) * mult, dtype=dtype) for i in range(N)]
+
+
+# ---------------------------------------------------------------- eager mode
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+def test_allreduce_sum_eager(hvd, dtype):
+    vals = _rank_values(dtype=dtype)
+    out = hvd.allreduce(hvd.per_rank(vals), op=hvd.Sum)
+    expected = sum(range(1, N + 1))
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               np.full((4,), expected), rtol=1e-2)
+
+
+def test_allreduce_average_eager(hvd):
+    vals = _rank_values()
+    out = hvd.allreduce(hvd.per_rank(vals), op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 4.5), rtol=1e-6)
+
+
+def test_allreduce_default_is_average(hvd):
+    vals = _rank_values()
+    out = hvd.allreduce(hvd.per_rank(vals))
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 4.5), rtol=1e-6)
+
+
+def test_allreduce_min_max_product(hvd):
+    vals = _rank_values()
+    out_min = hvd.allreduce(hvd.per_rank(vals), op=hvd.Min)
+    out_max = hvd.allreduce(hvd.per_rank(vals), op=hvd.Max)
+    out_prod = hvd.allreduce(hvd.per_rank(vals), op=hvd.Product)
+    np.testing.assert_allclose(np.asarray(out_min), np.full((4,), 1.0))
+    np.testing.assert_allclose(np.asarray(out_max), np.full((4,), 8.0))
+    np.testing.assert_allclose(np.asarray(out_prod),
+                               np.full((4,), float(np.prod(range(1, 9)))))
+
+
+def test_allreduce_prescale_postscale(hvd):
+    vals = _rank_values()
+    out = hvd.allreduce(hvd.per_rank(vals), op=hvd.Sum,
+                        prescale_factor=2.0, postscale_factor=0.5)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 36.0))
+
+
+def test_allreduce_average_int_raises(hvd):
+    with pytest.raises(TypeError):
+        hvd.allreduce(hvd.per_rank(_rank_values(dtype=jnp.int32)), op=hvd.Average)
+
+
+def test_allreduce_replicated_input(hvd):
+    # plain array = same contribution from every rank
+    out = hvd.allreduce(jnp.ones((3,)), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), np.full((3,), 8.0))
+
+
+def test_grouped_allreduce_eager(hvd):
+    t1 = _rank_values((4,))
+    t2 = _rank_values((2, 3), mult=10.0)
+    t3 = [jnp.full((5,), i + 1, jnp.int32) for i in range(N)]
+    outs = hvd.grouped_allreduce(
+        [hvd.per_rank(t1), hvd.per_rank(t2), hvd.per_rank(t3)], op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((4,), 36.0))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full((2, 3), 360.0))
+    np.testing.assert_array_equal(np.asarray(outs[2]), np.full((5,), 36, np.int32))
+    assert outs[2].dtype == jnp.int32
+
+
+def test_allgather_eager(hvd):
+    vals = [jnp.full((2, 3), i, jnp.float32) for i in range(N)]
+    out = hvd.allgather(hvd.per_rank(vals))
+    assert out.shape == (16, 3)
+    for i in range(N):
+        np.testing.assert_allclose(np.asarray(out[2 * i:2 * i + 2]), i)
+
+
+def test_allgather_scalars(hvd):
+    out = hvd.allgather(hvd.per_rank([jnp.float32(i) for i in range(N)]))
+    np.testing.assert_allclose(np.asarray(out), np.arange(N, dtype=np.float32))
+
+
+def test_broadcast_eager(hvd):
+    vals = _rank_values()
+    for root in (0, 3, 7):
+        out = hvd.broadcast(hvd.per_rank(vals), root)
+        np.testing.assert_allclose(np.asarray(out), np.full((4,), root + 1.0))
+
+
+def test_broadcast_bool(hvd):
+    vals = [jnp.full((3,), i % 2 == 0) for i in range(N)]
+    out = hvd.broadcast(hvd.per_rank(vals), 1)
+    assert out.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(out), np.zeros((3,), bool))
+
+
+def test_alltoall_eager(hvd):
+    # rank i sends row j*1 chunk valued i*10+j to rank j
+    vals = [jnp.arange(N, dtype=jnp.float32) + 10 * i for i in range(N)]
+    out = hvd.alltoall(hvd.per_rank(vals))
+    assert isinstance(out, hvd.PerRank)
+    recv = np.asarray(out.array)
+    for j in range(N):
+        np.testing.assert_allclose(recv[j], np.array([10 * i + j for i in range(N)]))
+
+
+def test_reducescatter_eager(hvd):
+    vals = [jnp.arange(16, dtype=jnp.float32) * (i + 1) for i in range(N)]
+    out = hvd.reducescatter(hvd.per_rank(vals), op=hvd.Sum)
+    recv = np.asarray(out.array)
+    total = np.arange(16, dtype=np.float32) * 36.0
+    np.testing.assert_allclose(recv.reshape(-1), total)
+
+
+def test_barrier_and_join(hvd):
+    hvd.barrier()
+    assert hvd.join() == hvd.size() - 1
+
+
+def test_async_handles(hvd):
+    h = hvd.allreduce_async(hvd.per_rank(_rank_values()), op=hvd.Sum)
+    out = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 36.0))
+
+
+# ---------------------------------------------------------------- traced mode
+
+def _shard_mapped(hvd, fn, x, out_specs=P("hvd")):
+    return jax.jit(jax.shard_map(
+        fn, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=out_specs,
+        check_vma=False))(x)
+
+
+def test_allreduce_traced(hvd):
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+
+    def step(v):
+        return hvd.allreduce(v, op=hvd.Sum)
+
+    out = _shard_mapped(hvd, step, x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(N, 36.0))
+
+
+def test_allreduce_average_traced(hvd):
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+    out = _shard_mapped(hvd, lambda v: hvd.allreduce(v, op=hvd.Average), x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(N, 4.5))
+
+
+def test_allgather_traced(hvd):
+    x = jnp.arange(8.0).reshape(N, 1)
+    out = _shard_mapped(hvd, lambda v: hvd.allgather(v), x)
+    # each rank gathers all 8 values -> global result is (8*8, 1) stacked
+    assert out.shape == (64, 1)
+    np.testing.assert_allclose(np.asarray(out[:8]).ravel(), np.arange(8.0))
+
+
+def test_broadcast_traced(hvd):
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+    out = _shard_mapped(hvd, lambda v: hvd.broadcast(v, 2), x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(N, 3.0))
+
+
+def test_grouped_allreduce_traced(hvd):
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+
+    def step(v):
+        a, b = hvd.grouped_allreduce([v, v * 2], op=hvd.Sum)
+        return a + b
+
+    out = _shard_mapped(hvd, step, x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(N, 108.0))
+
+
+def test_traced_inside_user_axis_name(hvd):
+    # user meshes with their own axis names work via axis_name=
+    import numpy as onp
+    from jax.sharding import Mesh
+    mesh = Mesh(onp.array(jax.devices()), ("dp",))
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+    fn = jax.jit(jax.shard_map(
+        lambda v: hvd.allreduce(v, op=hvd.Sum, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False))
+    np.testing.assert_allclose(np.asarray(fn(x)).ravel(), np.full(N, 36.0))
+
+
+def test_allreduce_average_over_subaxis(hvd):
+    """AVERAGE must divide by the bound axis size, not the world size
+    (regression: dp-axis average on a (dp, tp) mesh)."""
+    import numpy as onp
+    from jax.sharding import Mesh
+    mesh = Mesh(onp.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+    x = jnp.arange(16.0).reshape(8, 2)  # x[m, j] = 2m + j
+    fn = jax.jit(jax.shard_map(
+        lambda v: hvd.allreduce(v, op=hvd.Average, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp", "tp"), out_specs=P("dp", "tp"),
+        check_vma=False))
+    out = np.asarray(fn(x))
+    # mean over the 4 dp shards of each (2, 1) block; world size is 8 —
+    # dividing by 8 (the old bug) would halve these values
+    np.testing.assert_allclose(out, np.tile([[6.0, 7.0], [8.0, 9.0]], (4, 1)))
+
+
+def test_gspmd_passthrough_min_raises(hvd):
+    with pytest.raises(RuntimeError):
+        jax.jit(lambda v: hvd.allreduce(v, op=hvd.Min))(jnp.ones(2))

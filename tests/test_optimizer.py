@@ -1,0 +1,140 @@
+"""DistributedOptimizer / gradient-tape tests (reference analog:
+``test/parallel/test_torch.py`` optimizer tests and
+``test_tensorflow2_keras.py`` aggregation tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+N = 8
+
+
+def test_distributed_optimizer_traced_sgd(hvd):
+    """SPMD data-parallel step: per-rank grads differ; after the wrapped
+    update every rank applies the *mean* gradient."""
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0))
+    params = {"w": jnp.zeros((3,))}
+    state = jax.eval_shape(lambda: None)  # placeholder
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+
+    def step(xi):
+        grads = {"w": jnp.full((3,), xi[0])}
+        st = tx.init(params)
+        updates, _ = tx.update(grads, st, params)
+        return optax.apply_updates(params, updates)["w"]
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))(x)
+    got = np.asarray(out).reshape(N, 3)
+    np.testing.assert_allclose(got, np.full((N, 3), -4.5), rtol=1e-6)
+
+
+def test_value_and_grad_traced(hvd):
+    def loss(w, xi):
+        return jnp.sum(w * xi)
+
+    vg = hvd.value_and_grad(loss, op=hvd.Average)
+    x = jnp.arange(1.0, 9.0).reshape(N, 1)
+
+    def step(xi):
+        _, g = vg(jnp.ones((1,)), xi)
+        return g
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(N, 4.5))
+
+
+def test_grad_wrapper(hvd):
+    g = hvd.grad(lambda w: jnp.sum(w ** 2))
+    out = g(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 2.0))
+
+
+def test_compression_fp16(hvd):
+    tensor = jnp.full((4,), 3.0)
+    c, ctx = hvd.Compression.fp16.compress(tensor)
+    assert c.dtype == jnp.float16
+    d = hvd.Compression.fp16.decompress(c, ctx)
+    assert d.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(d), 3.0)
+
+
+def test_compression_bf16_in_tape(hvd):
+    vg = hvd.value_and_grad(lambda w: jnp.sum(w * 2), compression=hvd.Compression.bf16)
+    _, g = vg(jnp.ones((4,)))
+    assert g.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(g), 2.0)
+
+
+def test_backward_passes_per_step(hvd):
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=2)
+    params = {"w": jnp.zeros((2,))}
+    st = tx.init(params)
+    g1 = {"w": jnp.full((2,), 1.0)}
+    g2 = {"w": jnp.full((2,), 3.0)}
+    u1, st = tx.update(g1, st, params)
+    # first of 2 passes: no update applied yet
+    np.testing.assert_allclose(np.asarray(u1["w"]), 0.0)
+    u2, st = tx.update(g2, st, params)
+    # second pass: mean grad (1+3)/2 = 2 -> update -2
+    np.testing.assert_allclose(np.asarray(u2["w"]), -2.0)
+
+
+def test_broadcast_parameters(hvd):
+    params = {"a": jnp.ones((3,)), "b": {"c": jnp.zeros((2, 2))}}
+    out = hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out["a"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["b"]["c"]), 0.0)
+
+
+def test_broadcast_optimizer_state(hvd):
+    tx = optax.adam(1e-3)
+    st = tx.init({"w": jnp.ones((3,))})
+    out = hvd.broadcast_optimizer_state(st, root_rank=0)
+    chex_leaves = jax.tree.leaves(out)
+    assert len(chex_leaves) == len(jax.tree.leaves(st))
+
+
+def test_broadcast_object(hvd):
+    obj = {"epoch": 3, "name": "resnet"}
+    assert hvd.broadcast_object(obj, 0) == obj
+
+
+def test_allgather_object(hvd):
+    assert hvd.allgather_object({"r": 1}) == [{"r": 1}]
+
+
+def test_adasum_eager_two_orthogonal(hvd):
+    """Orthogonal gradients should (nearly) add; parallel identical
+    gradients should average to the same vector (scale invariance) —
+    numerics per adasum.h:248-342."""
+    ps = hvd.add_process_set([0, 1])
+    a = jnp.array([1.0, 0.0])
+    b = jnp.array([0.0, 1.0])
+    out = hvd.allreduce(hvd.per_rank([a, b], ps), op=hvd.Adasum, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out), [1.0, 1.0], atol=1e-6)
+    hvd.remove_process_set(ps)
+
+
+def test_adasum_identical_gradients(hvd):
+    """n identical gradients g: pairwise combine gives (1-1/2)g+(1-1/2)g = g,
+    so the result stays g at every level."""
+    g = jnp.array([2.0, -1.0, 0.5])
+    out = hvd.allreduce(hvd.per_rank([g] * 8), op=hvd.Adasum)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(g), rtol=1e-6)
+
+
+def test_grad_has_aux(hvd):
+    def loss(w):
+        return jnp.sum(w ** 2), {"n": w.shape[0]}
+
+    grads, aux = hvd.grad(loss, has_aux=True)(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(grads), 2.0)
+    assert aux == {"n": 3}

@@ -1,0 +1,121 @@
+"""Process-set tests (reference analog:
+``test/parallel/test_process_sets_static.py`` /
+``test_process_sets_dynamic`` paths in ``test_tensorflow.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def test_add_remove_process_set(hvd):
+    ps = hvd.add_process_set([1, 3, 5])
+    assert ps.process_set_id is not None and ps.process_set_id > 0
+    assert ps.ranks == [1, 3, 5]
+    assert ps.size() == 3
+    assert ps.included(3) and not ps.included(2)
+    assert ps.rank(5) == 2 and ps.rank(0) == -1
+    hvd.remove_process_set(ps)
+    assert ps.process_set_id is None
+
+
+def test_duplicate_process_set_dedup(hvd):
+    a = hvd.add_process_set([0, 2])
+    b = hvd.add_process_set([2, 0])
+    assert a.process_set_id == b.process_set_id
+    hvd.remove_process_set(a)
+
+
+def test_cannot_remove_global(hvd):
+    with pytest.raises(ValueError):
+        hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_allreduce_on_subset_eager(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    vals = [jnp.full((2,), i + 1.0) for i in range(4)]
+    out = hvd.allreduce(hvd.per_rank(vals, ps), op=hvd.Sum, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out), np.full((2,), 10.0))
+    hvd.remove_process_set(ps)
+
+
+def test_broadcast_on_subset_eager(hvd):
+    ps = hvd.add_process_set([2, 5, 7])
+    vals = [jnp.full((2,), r * 1.0) for r in [2, 5, 7]]
+    out = hvd.broadcast(hvd.per_rank(vals, ps), 5, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out), np.full((2,), 5.0))
+    hvd.remove_process_set(ps)
+
+
+def test_allgather_on_subset_eager(hvd):
+    ps = hvd.add_process_set([1, 4])
+    vals = [jnp.full((2, 2), r * 1.0) for r in [1, 4]]
+    out = hvd.allgather(hvd.per_rank(vals, ps), process_set=ps)
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(np.asarray(out[:2]), 1.0)
+    np.testing.assert_allclose(np.asarray(out[2:]), 4.0)
+    hvd.remove_process_set(ps)
+
+
+def test_subset_allreduce_traced(hvd):
+    ps = hvd.add_process_set([0, 1, 2])
+    x = jnp.arange(1.0, 9.0).reshape(8, 1)
+
+    def step(v):
+        return hvd.allreduce(v, op=hvd.Sum, process_set=ps)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))(x)
+    got = np.asarray(out).ravel()
+    # members reduce to 1+2+3=6; non-members reduce within singleton groups
+    np.testing.assert_allclose(got[:3], 6.0)
+    np.testing.assert_allclose(got[3:], np.arange(4.0, 9.0))
+    hvd.remove_process_set(ps)
+
+
+def test_subset_allgather_traced(hvd):
+    ps = hvd.add_process_set([1, 3, 5])
+    x = jnp.arange(1.0, 9.0).reshape(8, 1)
+
+    def step(v):
+        return hvd.allgather(v, process_set=ps)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))(x)
+    got = np.asarray(out).reshape(8, 3)
+    for row in (1, 3, 5):
+        np.testing.assert_allclose(got[row], [2.0, 4.0, 6.0])
+    hvd.remove_process_set(ps)
+
+
+def test_subset_broadcast_traced(hvd):
+    ps = hvd.add_process_set([2, 6])
+    x = jnp.arange(1.0, 9.0).reshape(8, 1)
+
+    def step(v):
+        return hvd.broadcast(v, 6, process_set=ps)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))(x)
+    got = np.asarray(out).ravel()
+    assert got[2] == 7.0 and got[6] == 7.0  # members got root's value
+    np.testing.assert_allclose(got[[0, 1, 3, 4, 5, 7]],
+                               [1.0, 2.0, 4.0, 5.0, 6.0, 8.0])
+    hvd.remove_process_set(ps)
+
+
+def test_dynamic_gate():
+    import horovod_tpu.process_sets as psmod
+    import horovod_tpu.runtime as rt
+    table = rt.process_set_table()
+    saved = table.dynamic_enabled
+    table.dynamic_enabled = False
+    try:
+        with pytest.raises(RuntimeError):
+            table.add([0, 1])
+    finally:
+        table.dynamic_enabled = saved

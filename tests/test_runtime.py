@@ -1,0 +1,47 @@
+"""Runtime init / rank-query tests (reference analog:
+``test/parallel/test_tensorflow.py`` rank/size tests and
+``horovod/common/basics.py`` behavior)."""
+
+import pytest
+
+
+def test_initialized(hvd):
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8  # single process drives all virtual chips
+    assert hvd.rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.process_count() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_mesh_shape(hvd):
+    mesh = hvd.mesh()
+    assert mesh.shape[hvd.axis_name()] == 8
+    assert len(hvd.devices()) == 8
+
+
+def test_double_init_is_noop(hvd):
+    hvd.init()  # second call must not raise or reset state
+    assert hvd.size() == 8
+
+
+def test_uninitialized_raises():
+    import horovod_tpu.runtime as rt
+    saved = rt._state
+    rt._state = None
+    try:
+        with pytest.raises(rt.NotInitializedError):
+            rt.size()
+    finally:
+        rt._state = saved
+
+
+def test_global_process_set(hvd):
+    ps = hvd.global_process_set
+    assert ps.process_set_id == 0
+    assert ps.size() == 8
+    assert ps.ranks == list(range(8))
+    assert ps.included(3)
+    assert ps.rank(5) == 5
