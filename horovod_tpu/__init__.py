@@ -60,6 +60,7 @@ from .ops import (
     broadcast_async,
     broadcast_object,
     grouped_allreduce,
+    grouped_broadcast,
     join,
     per_rank,
     poll,
@@ -79,7 +80,6 @@ from .optim import (
     value_and_grad,
 )
 from .functions import (
-    broadcast_object as _bo,  # re-exported above via ops
     broadcast_optimizer_state,
     broadcast_parameters,
     broadcast_variables,
@@ -100,7 +100,7 @@ __all__ = [
     "Product", "ReduceOp", "Sum", "adasum_allreduce", "allgather",
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
-    "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce",
+    "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce", "grouped_broadcast",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
     "ProcessSet", "add_process_set", "global_process_set", "remove_process_set",
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",

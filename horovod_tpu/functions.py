@@ -24,10 +24,12 @@ def broadcast_parameters(params, root_rank: int = 0,
                          process_set: ProcessSet | None = None):
     """Broadcast a pytree of arrays from ``root_rank`` to all ranks
     (reference ``broadcast_parameters``, ``torch/functions.py``).
-    Returns the synchronized pytree."""
-    return jax.tree.map(
-        lambda x: collectives.broadcast(x, root_rank, process_set=process_set),
-        params)
+    Returns the synchronized pytree. Leaves are fused per dtype into
+    single wire buffers (see ``grouped_broadcast``)."""
+    leaves, treedef = jax.tree.flatten(params)
+    synced = collectives.grouped_broadcast(
+        leaves, root_rank, process_set=process_set)
+    return jax.tree.unflatten(treedef, synced)
 
 
 # TF-parity alias (reference ``broadcast_variables``, tensorflow/functions.py)
@@ -37,13 +39,17 @@ broadcast_variables = broadcast_parameters
 def broadcast_optimizer_state(opt_state, root_rank: int = 0,
                               process_set: ProcessSet | None = None):
     """Broadcast optimizer state (reference ``broadcast_optimizer_state``).
-    optax states are array pytrees, so this is the same tree broadcast —
-    non-array leaves (step counts as python ints, None) pass through."""
-    def _bcast(x):
-        if hasattr(x, "dtype") and hasattr(x, "shape"):
-            return collectives.broadcast(x, root_rank, process_set=process_set)
-        return x
-    return jax.tree.map(_bcast, opt_state)
+    optax states are array pytrees, so this is the same fused tree
+    broadcast — non-array leaves (step counts as python ints, None) pass
+    through."""
+    leaves, treedef = jax.tree.flatten(opt_state)
+    is_array = [hasattr(x, "dtype") and hasattr(x, "shape") for x in leaves]
+    synced = collectives.grouped_broadcast(
+        [x for x, a in zip(leaves, is_array) if a], root_rank,
+        process_set=process_set)
+    it = iter(synced)
+    out = [next(it) if a else x for x, a in zip(leaves, is_array)]
+    return jax.tree.unflatten(treedef, out)
 
 
 broadcast_object = collectives.broadcast_object

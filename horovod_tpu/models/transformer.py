@@ -127,8 +127,8 @@ def param_shardings(params, *, tp_axis: str = "tp"):
             return P(tp_axis, None)
         if joined.endswith("lm_head/kernel"):
             return P(None, tp_axis)
-        if joined.endswith("embed/embedding"):
-            return P(tp_axis, None)
+        if joined == "embed/embedding":  # vocab table only; pos_embed stays
+            return P(tp_axis, None)      # replicated (seq rarely divides tp)
         return P(*([None] * nd))
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

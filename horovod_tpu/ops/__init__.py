@@ -15,6 +15,7 @@ from .collectives import (
     broadcast_async,
     broadcast_object,
     grouped_allreduce,
+    grouped_broadcast,
     join,
     per_rank,
     poll,
@@ -28,6 +29,6 @@ __all__ = [
     "Compression", "Handle", "PerRank", "allgather", "allgather_async",
     "allgather_object", "allreduce", "allreduce_async", "alltoall",
     "alltoall_async", "barrier", "broadcast", "broadcast_async",
-    "broadcast_object", "grouped_allreduce", "join", "per_rank", "poll",
+    "broadcast_object", "grouped_allreduce", "grouped_broadcast", "join", "per_rank", "poll",
     "reducescatter", "synchronize", "adasum_allreduce",
 ]

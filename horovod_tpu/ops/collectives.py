@@ -262,6 +262,45 @@ def _eager_broadcast_fn(mesh: Mesh, axis: str, root_pos: int):
 
 
 @functools.lru_cache(maxsize=None)
+def _eager_grouped_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
+                                num_bufs: int):
+    def inner(*xs):
+        return tuple(_broadcast_traced(x[0], axis, root_pos, None, None)
+                     for x in xs)
+    specs = tuple(P(axis) for _ in range(num_bufs))
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=specs, out_specs=tuple(P() for _ in specs),
+        check_vma=False))
+
+
+def _fuse_by_dtype(bundles: list, n: int):
+    """Pack (n, ...) bundles into one flat (n, total) wire buffer per dtype
+    (the XLA analog of the reference's fusion buffer,
+    ``fusion_buffer_manager.h:30-50``). Returns (fused_inputs, metas)."""
+    by_dtype: dict = {}
+    for i, b in enumerate(bundles):
+        by_dtype.setdefault(jnp.result_type(b), []).append(i)
+    fused_inputs, metas = [], []
+    for dt, idxs in by_dtype.items():
+        flat = [bundles[i].reshape(n, -1) for i in idxs]
+        fused_inputs.append(jnp.concatenate(flat, axis=1))
+        metas.append((dt, idxs, [bundles[i].shape[1:] for i in idxs]))
+    return fused_inputs, metas
+
+
+def _split_fused(fused_outputs, metas, count: int) -> list:
+    """Inverse of :func:`_fuse_by_dtype` on flat per-dtype result vectors."""
+    results: list = [None] * count
+    for vec, (dt, idxs, shapes) in zip(fused_outputs, metas):
+        offset = 0
+        for i, shp in zip(idxs, shapes):
+            sz = int(np.prod(shp)) if shp else 1
+            results[i] = vec[offset:offset + sz].reshape(shp)
+            offset += sz
+    return results
+
+
+@functools.lru_cache(maxsize=None)
 def _eager_alltoall_fn(mesh: Mesh, axis: str):
     def inner(x):  # (1, s, ...) -> (s, ...) per-rank
         return _alltoall_traced(x[0], axis, None)
@@ -293,14 +332,18 @@ def _as_bundle(tensor, pset: ProcessSet):
 
 
 def _gspmd_passthrough_check(op: ReduceOp, name: str) -> None:
-    """Inside plain jit/pjit only the gradient-reduction ops (SUM/AVERAGE)
-    are equivalent to the partitioner's own reduction; anything else has no
-    GSPMD meaning and must run under shard_map."""
-    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+    """Inside plain jit/pjit only AVERAGE is the identity: gradients of a
+    globally-sharded computation are already globally *averaged* by the
+    partitioner (a mean loss over the global batch). SUM would differ from
+    the local value by a factor of size() and anything else has no GSPMD
+    meaning — both must run under shard_map where the semantics are
+    explicit."""
+    if op != ReduceOp.AVERAGE:
         raise RuntimeError(
             f"{name}(op={op.name}) was called inside jit/pjit without a "
-            "bound mesh axis; only SUM/AVERAGE have GSPMD passthrough "
-            "semantics. Run it under jax.shard_map over hvd.mesh().")
+            "bound mesh axis; only AVERAGE (gradient reduction) is an "
+            "identity under GSPMD. Run it under jax.shard_map over "
+            "hvd.mesh() so the op lowers to an explicit XLA collective.")
     hvd_logging.debug(
         "%s inside jit/pjit without a bound axis: GSPMD passthrough "
         "(gradients are already globally reduced by the partitioner)", name)
@@ -390,27 +433,13 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     # --- eager fusion path ---
     n = pset.size()
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
-    by_dtype: dict = {}
-    for i, b in enumerate(bundles):
-        by_dtype.setdefault(jnp.result_type(b), []).append(i)
-    fused_inputs, metas = [], []
-    for dt, idxs in by_dtype.items():
-        flat = [bundles[i].reshape(n, -1) for i in idxs]
-        fused_inputs.append(jnp.concatenate(flat, axis=1))
-        metas.append((dt, idxs, [bundles[i].shape[1:] for i in idxs]))
+    fused_inputs, metas = _fuse_by_dtype(bundles, n)
     fn = _eager_grouped_allreduce_fn(pset.mesh(), axis, lowered_op,
                                      float(prescale_factor), float(post),
                                      len(fused_inputs))
     fused_outputs = fn(*fused_inputs)
-    results: list = [None] * len(tensors)
-    for buf, (dt, idxs, shapes) in zip(fused_outputs, metas):
-        offset = 0
-        vec = buf[0]  # identical on every rank
-        for i, shp in zip(idxs, shapes):
-            sz = int(np.prod(shp)) if shp else 1
-            results[i] = vec[offset:offset + sz].reshape(shp)
-            offset += sz
-    return results
+    # row 0 of each (n, total) buffer: identical on every rank
+    return _split_fused([buf[0] for buf in fused_outputs], metas, len(tensors))
 
 
 def allgather(tensor, *, process_set: ProcessSet | None = None,
@@ -461,6 +490,40 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
     bundle, _ = _as_bundle(tensor, pset)
     root_pos = pset.ranks.index(root_rank)
     return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
+
+
+def grouped_broadcast(tensors: Sequence, root_rank: int, *,
+                      process_set: ProcessSet | None = None,
+                      name: str | None = None, axis_name=None):
+    """Fused broadcast of a tensor list from ``root_rank``. Eager mode packs
+    the tensors into one wire buffer per dtype (same fusion scheme as
+    :func:`grouped_allreduce`, the analog of the reference's fusion buffer)
+    so ``broadcast_parameters`` over a large model dispatches O(dtypes)
+    programs instead of O(leaves)."""
+    del name
+    if not tensors:
+        return []
+    pset = _resolve(process_set)
+    axis = _resolve_axis(axis_name)
+    if root_rank not in pset.ranks:
+        raise ValueError(f"root_rank {root_rank} not in process set {pset.ranks}")
+    if _axis_is_bound(axis):
+        groups = pset.axis_index_groups()
+        return [_broadcast_traced(t, axis, root_rank, groups, pset.ranks)
+                for t in tensors]
+    if any(_contains_tracer(t) for t in tensors):
+        raise RuntimeError(
+            "grouped_broadcast() was called inside jit/pjit without a bound "
+            "mesh axis. Run it under jax.shard_map over hvd.mesh() (or pass "
+            "axis_name=) so the ops can lower to XLA collectives.")
+    n = pset.size()
+    root_pos = pset.ranks.index(root_rank)
+    bundles = [_as_bundle(t, pset)[0] for t in tensors]
+    fused_inputs, metas = _fuse_by_dtype(bundles, n)
+    fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
+                                     len(fused_inputs))
+    fused_outputs = fn(*fused_inputs)
+    return _split_fused(fused_outputs, metas, len(tensors))
 
 
 def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
@@ -595,22 +658,24 @@ def synchronize(handle: Handle):
 # ---------------------------------------------------------------------------
 
 def broadcast_object(obj, root_rank: int = 0, *, name: str | None = None):
-    """Broadcast a picklable object from the root *process* (reference
-    ``broadcast_object``, ``torch/functions.py``). Objects live per
-    controller process, so this is a process-level broadcast."""
+    """Broadcast a picklable object from the process owning global chip
+    ``root_rank`` (reference ``broadcast_object``, ``torch/functions.py``).
+    Objects live per controller process, so this is a process-level
+    broadcast; ``root_rank`` is a chip rank like everywhere else in the
+    API and is mapped to its owning process."""
     del name
     if runtime.process_count() <= 1:
         return obj
     from jax.experimental import multihost_utils
+    root_process = runtime.devices()[root_rank].process_index
+    is_source = runtime.process_rank() == root_process
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     size = multihost_utils.broadcast_one_to_all(
-        np.array(len(payload), np.int64),
-        is_source=runtime.process_rank() == root_rank)
+        np.array(len(payload), np.int64), is_source=is_source)
     buf = np.zeros(int(size), np.uint8)
-    if runtime.process_rank() == root_rank:
+    if is_source:
         buf[:] = payload
-    out = multihost_utils.broadcast_one_to_all(
-        buf, is_source=runtime.process_rank() == root_rank)
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
     return pickle.loads(out.tobytes())
 
 

@@ -37,6 +37,7 @@ class ProcessSet:
         self.process_set_id: int | None = None
         self._ranks: list[int] | None = sorted(ranks) if ranks is not None else None
         self._mesh: Mesh | None = None
+        self._mesh_generation: int = -1
 
     # -- identity ----------------------------------------------------------
     @property
@@ -68,13 +69,17 @@ class ProcessSet:
         return self.size() == runtime.size() and self.ranks == list(range(runtime.size()))
 
     def mesh(self) -> Mesh:
-        """Sub-mesh over member chips, axis name == global axis name."""
+        """Sub-mesh over member chips, axis name == global axis name.
+        Cached per runtime generation so a set held across
+        shutdown()/init() never runs over stale device objects."""
         if self.is_global:
             return runtime.mesh()
-        if self._mesh is None:
+        gen = runtime.generation()
+        if self._mesh is None or self._mesh_generation != gen:
             devs = runtime.devices()
             members = [devs[r] for r in self.ranks]
             self._mesh = Mesh(np.array(members), (runtime.axis_name(),))
+            self._mesh_generation = gen
         return self._mesh
 
     def axis_index_groups(self) -> list[list[int]] | None:

@@ -1,0 +1,100 @@
+"""Launcher package: ``hvdrun`` CLI + programmatic ``run()``.
+
+TPU-native rebuild of ``/root/reference/horovod/runner/`` (CLI at
+``launch.py:739-775``, programmatic API at ``__init__.py:93-214``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import cloudpickle
+
+from . import hosts as hosts_mod
+from . import safe_exec
+from .hosts import HostSpec, SlotInfo, get_host_assignments, parse_hosts, parse_hostfile
+from .http_kv import KVClient, KVServer, local_addresses, make_secret
+from .launch import (
+    is_local_host,
+    main,
+    parse_args,
+    run_commandline,
+    run_static,
+    spawn_worker,
+    worker_env,
+)
+
+
+def run(fn, args=(), kwargs=None, np: int = 1, *, hosts: str | None = None,
+        hostfile: str | None = None, env: dict | None = None,
+        ssh_port: int | None = None, ssh_identity_file: str | None = None,
+        verbose: bool = False, start_timeout: float = 600.0) -> list:
+    """Run ``fn(*args, **kwargs)`` on ``np`` distributed workers and return
+    the per-rank results, rank-ordered (reference ``horovod.run``,
+    ``/root/reference/horovod/runner/__init__.py:93-214``)."""
+    from .launch import _free_port, _resolve_hosts
+
+    kwargs = kwargs or {}
+    ns = parse_args(["-np", str(np)] +
+                    (["-H", hosts] if hosts else []) +
+                    (["--hostfile", hostfile] if hostfile else []) +
+                    (["--ssh-port", str(ssh_port)] if ssh_port else []) +
+                    (["--ssh-identity-file", ssh_identity_file]
+                     if ssh_identity_file else []) +
+                    (["--verbose"] if verbose else []) +
+                    ["--", "ignored"])
+    specs = _resolve_hosts(ns)
+    slots = get_host_assignments(specs, np)
+
+    secret = make_secret()
+    kv = KVServer(secret=secret)
+    kv_port = kv.start()
+    kv.put("exec/fn", cloudpickle.dumps((fn, tuple(args), kwargs)))
+
+    all_local = all(is_local_host(s.hostname) for s in slots)
+    my_addr = "127.0.0.1" if all_local else local_addresses()[0]
+    # jax.distributed coordinator binds inside rank 0's process, so it must
+    # be addressed by rank 0's host (mirrors run_static).
+    coord_host = slots[0].hostname
+    coord_addr = "127.0.0.1" if all_local else (
+        my_addr if is_local_host(coord_host) else coord_host)
+    coord_port = _free_port()
+    command = [sys.executable, "-m", "horovod_tpu.runner.task_exec"]
+
+    procs = []
+    try:
+        for slot in slots:
+            wenv = worker_env(
+                slot, coordinator_addr=coord_addr, coordinator_port=coord_port,
+                kv_addr=my_addr, kv_port=kv_port, secret=secret,
+                extra={**(env or {}),
+                       "HVD_START_TIMEOUT": str(start_timeout)})
+            procs.append(spawn_worker(slot, command, wenv, ns))
+        # start_timeout bounds job startup only; a healthy worker may run
+        # indefinitely, so the overall wait is unbounded.
+        codes = [p.wait() for p in procs]
+        results = []
+        for slot in slots:
+            raw = kv.get(f"exec/result/{slot.rank}")
+            if raw is None:
+                raise RuntimeError(
+                    f"rank {slot.rank} produced no result "
+                    f"(exit code {codes[slot.rank]})")
+            status, value = cloudpickle.loads(raw)
+            if status == "error":
+                raise RuntimeError(f"rank {slot.rank} failed:\n{value}")
+            results.append(value)
+        return results
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        kv.stop()
+
+
+__all__ = [
+    "HostSpec", "SlotInfo", "KVClient", "KVServer", "get_host_assignments",
+    "hosts_mod", "is_local_host", "local_addresses", "main", "make_secret",
+    "parse_args", "parse_hostfile", "parse_hosts", "run", "run_commandline",
+    "run_static", "safe_exec", "spawn_worker", "worker_env",
+]

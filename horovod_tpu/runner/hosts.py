@@ -1,0 +1,135 @@
+"""Host / slot parsing and rank assignment.
+
+TPU-native rebuild of the reference's host machinery
+(``/root/reference/horovod/runner/common/util/hosts.py`` and the host parsing
+in ``/root/reference/horovod/runner/launch.py:242-528``): ``-H h1:4,h2:4``
+style host lists, hostfiles, and the host-major contiguous rank layout that
+the rest of the stack (local_rank / cross_rank) is derived from.
+
+On TPU a "slot" is a controller process (one per host by default — a single
+jax process drives every chip of its host), so typical TPU hostfiles use
+``slots=1`` per host, but the assignment math supports any slot count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+class HostParseError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class HostSpec:
+    hostname: str
+    slots: int
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise HostParseError(
+                f"host {self.hostname!r} has invalid slot count {self.slots}")
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    """One rank's placement (reference ``hosts.py`` SlotInfo): global rank,
+    position within its host (local) and across hosts (cross)."""
+    hostname: str
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+    def to_response_string(self) -> str:
+        return (f"{self.hostname},{self.rank},{self.size},{self.local_rank},"
+                f"{self.local_size},{self.cross_rank},{self.cross_size}")
+
+
+_HOST_RE = re.compile(r"^(?P<host>\[[^\]]+\]|[^:\s]+)(:(?P<slots>\d+))?$")
+
+
+def parse_hosts(hosts_string: str) -> list[HostSpec]:
+    """Parse ``"h1:4,h2:4"`` (reference ``parse_hosts``; slots default 1)."""
+    specs = []
+    for part in hosts_string.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = _HOST_RE.match(part)
+        if not m:
+            raise HostParseError(f"invalid host specification: {part!r}")
+        specs.append(HostSpec(m.group("host"),
+                              int(m.group("slots") or 1)))
+    if not specs:
+        raise HostParseError(f"no hosts found in {hosts_string!r}")
+    return specs
+
+
+def parse_hostfile(path: str) -> list[HostSpec]:
+    """Parse a hostfile with ``hostname slots=N`` lines (reference
+    ``parse_host_files``, ``launch.py``). ``#`` comments allowed."""
+    specs = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            hostname = fields[0]
+            slots = 1
+            for field in fields[1:]:
+                if field.startswith("slots="):
+                    slots = int(field[len("slots="):])
+                else:
+                    raise HostParseError(
+                        f"{path}:{lineno}: unrecognized field {field!r}")
+            specs.append(HostSpec(hostname, slots))
+    if not specs:
+        raise HostParseError(f"hostfile {path} is empty")
+    return specs
+
+
+def total_slots(hosts: list[HostSpec]) -> int:
+    return sum(h.slots for h in hosts)
+
+
+def get_host_assignments(hosts: list[HostSpec], np: int) -> list[SlotInfo]:
+    """Assign ``np`` ranks to hosts, host-major and contiguous (reference
+    ``get_host_assignments``, ``hosts.py``): rank r lands on the first host
+    with a free slot; local_rank counts within the host; cross_rank indexes
+    the host among hosts that own a slot at the same local_rank.
+    """
+    if np < 1:
+        raise ValueError(f"np must be positive, got {np}")
+    capacity = total_slots(hosts)
+    if np > capacity:
+        raise ValueError(
+            f"requested np={np} exceeds total available slots {capacity} "
+            f"across {len(hosts)} hosts")
+
+    # slots actually used per host, host-major fill
+    used: list[int] = []
+    remaining = np
+    for h in hosts:
+        take = min(h.slots, remaining)
+        used.append(take)
+        remaining -= take
+    hosts_used = [(h, u) for h, u in zip(hosts, used) if u > 0]
+
+    assignments: list[SlotInfo] = []
+    rank = 0
+    for host_idx, (h, u) in enumerate(hosts_used):
+        for local_rank in range(u):
+            cross_rank = sum(1 for _, u2 in hosts_used[:host_idx]
+                             if u2 > local_rank)
+            cross_size = sum(1 for _, u2 in hosts_used if u2 > local_rank)
+            assignments.append(SlotInfo(
+                hostname=h.hostname, rank=rank, size=np,
+                local_rank=local_rank, local_size=u,
+                cross_rank=cross_rank, cross_size=cross_size))
+            rank += 1
+    return assignments

@@ -1,0 +1,243 @@
+"""Threaded HTTP key-value store for rendezvous and result exchange.
+
+TPU-native rebuild of the reference's rendezvous HTTP server
+(``/root/reference/horovod/runner/http/http_server.py:152-230`` and the
+client in ``http_client.py``): workers discover their placement and exchange
+small payloads through scoped keys. Payloads are HMAC-signed with the
+launcher's per-job secret, mirroring the reference's signed network messages
+(``/root/reference/horovod/runner/common/util/network.py`` +
+``secret.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import http.server
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+SECRET_ENV = "HVD_SECRET_KEY"
+_SIG_HEADER = "X-HVD-Signature"
+
+
+def make_secret() -> str:
+    return os.urandom(16).hex()
+
+
+def _sign(secret: str, method: str, path: str, payload: bytes) -> str:
+    """Signature covers method + key path + payload, so a captured message
+    can't be replayed against a different key or verb."""
+    msg = method.encode() + b"\0" + path.encode() + b"\0" + payload
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
+
+
+class KVHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence default stderr chatter
+        pass
+
+    def _key(self):
+        return self.path.lstrip("/")
+
+    def _verify(self, method: str, payload: bytes) -> bool:
+        secret = self.server.secret  # type: ignore[attr-defined]
+        if secret is None:
+            return True
+        sig = self.headers.get(_SIG_HEADER, "")
+        return hmac.compare_digest(
+            sig, _sign(secret, method, self.path, payload))
+
+    def _reject(self):
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._verify("GET", b""):
+            self._reject()
+            return
+        store = self.server.store  # type: ignore[attr-defined]
+        key = self._key()
+        with self.server.lock:  # type: ignore[attr-defined]
+            if key.endswith("/") or key == "":  # scope listing
+                scope = key.rstrip("/")
+                prefix = scope + "/" if scope else ""
+                keys = sorted(k for k in store if k.startswith(prefix))
+                body = json.dumps(keys).encode()
+            elif key in store:
+                body = store[key]
+            else:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        if not self._verify("PUT", payload):
+            self._reject()
+            return
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store[self._key()] = payload  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        if not self._verify("DELETE", b""):
+            self._reject()
+            return
+        key = self._key()
+        with self.server.lock:  # type: ignore[attr-defined]
+            store = self.server.store  # type: ignore[attr-defined]
+            for k in [k for k in store
+                      if k == key or k.startswith(key.rstrip("/") + "/")]:
+                del store[k]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class _ThreadedHTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class KVServer:
+    """In-memory scoped KV store served over HTTP (reference
+    ``RendezvousServer``). Start on an ephemeral port; share
+    ``addr``/``port``/``secret`` with workers via env."""
+
+    def __init__(self, secret: str | None = None):
+        self.secret = secret
+        self._httpd: _ThreadedHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self, port: int = 0) -> int:
+        self._httpd = _ThreadedHTTPServer(("0.0.0.0", port), KVHandler)
+        self._httpd.store = {}  # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.secret = self.secret  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="hvd-kv-server")
+        self._thread.start()
+        return self.port
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "server not started"
+        return self._httpd.server_address[1]
+
+    def put(self, key: str, value: bytes) -> None:
+        assert self._httpd is not None
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store[key] = value  # type: ignore[attr-defined]
+
+    def get(self, key: str) -> bytes | None:
+        assert self._httpd is not None
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.store.get(key)  # type: ignore[attr-defined]
+
+    def keys(self, scope: str = "") -> list[str]:
+        assert self._httpd is not None
+        prefix = scope.rstrip("/") + "/" if scope else ""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return sorted(k for k in self._httpd.store  # type: ignore[attr-defined]
+                          if k.startswith(prefix))
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class KVClient:
+    """HTTP client for :class:`KVServer` (reference ``http_client.py``)."""
+
+    def __init__(self, addr: str, port: int, secret: str | None = None,
+                 timeout: float = 30.0):
+        self._base = f"http://{addr}:{port}"
+        self._secret = secret
+        self._timeout = timeout
+
+    def _request(self, method: str, path: str, payload: bytes = b""):
+        req = urllib.request.Request(
+            f"{self._base}{path}", data=payload if method == "PUT" else None,
+            method=method)
+        if self._secret is not None:
+            req.add_header(_SIG_HEADER,
+                           _sign(self._secret, method, path, payload))
+        return urllib.request.urlopen(req, timeout=self._timeout)
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._request("PUT", f"/{key}", value) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"KV put {key}: HTTP {resp.status}")
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            with self._request("GET", f"/{key}") as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def keys(self, scope: str = "") -> list[str]:
+        with self._request("GET", f"/{scope.rstrip('/')}/") as resp:
+            return json.loads(resp.read())
+
+    def delete(self, key: str) -> None:
+        with self._request("DELETE", f"/{key}"):
+            pass
+
+    def wait(self, key: str, timeout: float = 60.0,
+             poll_interval: float = 0.1) -> bytes:
+        """Block until ``key`` appears (rendezvous barrier primitive)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            val = self.get(key)
+            if val is not None:
+                return val
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"KV key {key!r} not set within {timeout}s")
+            time.sleep(poll_interval)
+
+
+def local_addresses() -> list[str]:
+    """Routable addresses of this host (reference NIC discovery,
+    ``driver_service.py:122-193``, radically simplified: on TPU pods the
+    fabric is homogeneous so the default-route interface is correct)."""
+    addrs = []
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            addrs.append(s.getsockname()[0])
+        finally:
+            s.close()
+    except OSError:
+        pass
+    hostname_ip = None
+    try:
+        hostname_ip = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        pass
+    if hostname_ip and hostname_ip not in addrs:
+        addrs.append(hostname_ip)
+    if "127.0.0.1" not in addrs:
+        addrs.append("127.0.0.1")
+    return addrs

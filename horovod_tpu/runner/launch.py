@@ -1,0 +1,344 @@
+"""``hvdrun`` — the launcher CLI.
+
+TPU-native rebuild of the reference's ``horovodrun``
+(``/root/reference/horovod/runner/launch.py:242-775``): parse host/slot
+topology, seed per-worker env (rank layout + rendezvous coordinates), spawn
+one controller process per slot — locally or over ssh — and supervise the
+job. The gloo/MPI controller split disappears: workers rendezvous through
+``jax.distributed`` (coordinator = rank-0 host) plus the launcher's HTTP KV
+store (results, elastic notifications).
+
+Static path mirrors ``_run_static`` (``launch.py:530-620``); elastic path
+mirrors ``_run_elastic`` (``launch.py:623-672``) and is implemented in
+``horovod_tpu.elastic``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import sys
+import threading
+
+from . import hosts as hosts_mod
+from . import safe_exec
+from .http_kv import KVServer, local_addresses, make_secret
+from ..version import __version__
+
+SSH_OPTIONS = ["-o", "PasswordAuthentication=no",
+               "-o", "StrictHostKeyChecking=no",
+               "-o", "ConnectTimeout=10"]
+
+# env vars forwarded from the launcher environment to every worker
+# (reference forwards the full env over ssh via env exports,
+# gloo_run.py:114-199)
+_FORWARD_PREFIXES = ("HVD_", "HOROVOD_", "JAX_", "XLA_", "TPU_", "LIBTPU_",
+                     "PYTHON", "PATH", "LD_", "VIRTUAL_ENV", "HOME", "USER",
+                     "CUDA_", "TF_", "NCCL_")
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-v", "--version", action="version",
+                        version=f"hvdrun {__version__}")
+    parser.add_argument("-np", "--num-proc", dest="np", type=int, default=None,
+                        help="total number of worker processes")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help='host list, e.g. "h1:2,h2:2" (slots default 1)')
+    parser.add_argument("--hostfile", default=None,
+                        help='hostfile with "hostname slots=N" lines')
+    parser.add_argument("--slots-per-host", type=int, default=None,
+                        help="override slot count for every host")
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="elastic: minimum world size")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="elastic: maximum world size")
+    parser.add_argument("--host-discovery-script", default=None,
+                        help="elastic: executable printing one host:slots per line")
+    parser.add_argument("--ssh-port", type=int, default=None)
+    parser.add_argument("--ssh-identity-file", default=None)
+    parser.add_argument("--start-timeout", type=float, default=600.0,
+                        help="seconds to wait for the job to start")
+    parser.add_argument("--output-filename", default=None,
+                        help="redirect per-rank output to <dir>/rank.<N>/stdout|stderr")
+    parser.add_argument("--coordinator-port", type=int, default=0,
+                        help="port for jax.distributed coordinator (0 = auto)")
+    parser.add_argument("--config-file", default=None,
+                        help="YAML config file (CLI flags win)")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--disable-cache", action="store_true",
+                        help="set HVD_CACHE_CAPACITY=0 in workers")
+    parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--autotune", action="store_true")
+    parser.add_argument("--env", action="append", default=[],
+                        metavar="NAME=VALUE", help="extra env for workers")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training command")
+    args = parser.parse_args(argv)
+
+    if args.config_file:
+        from . import config_parser
+        cfg = config_parser.load_config(args.config_file)
+        explicit = _explicit_dests(argv if argv is not None else sys.argv[1:], parser)
+        config_parser.apply_config_to_args(cfg, args, explicit)
+        args._config_env = config_parser.config_to_env(cfg)
+    else:
+        args._config_env = {}
+    return args
+
+
+def _explicit_dests(argv, parser) -> set:
+    """Dest names of options actually present on the command line."""
+    explicit = set()
+    opt_to_dest = {}
+    for action in parser._actions:
+        for opt in action.option_strings:
+            opt_to_dest[opt] = action.dest
+    for tok in argv:
+        if tok == "--":
+            break
+        if tok.startswith("-"):
+            opt = tok.split("=", 1)[0]
+            if opt in opt_to_dest:
+                explicit.add(opt_to_dest[opt])
+    return explicit
+
+
+def _resolve_hosts(args) -> list[hosts_mod.HostSpec]:
+    if args.hosts and args.hostfile:
+        raise ValueError("--hosts and --hostfile are mutually exclusive")
+    if args.hosts:
+        specs = hosts_mod.parse_hosts(args.hosts)
+    elif args.hostfile:
+        specs = hosts_mod.parse_hostfile(args.hostfile)
+    else:
+        specs = [hosts_mod.HostSpec("localhost", args.np or 1)]
+    if args.slots_per_host:
+        specs = [hosts_mod.HostSpec(h.hostname, args.slots_per_host)
+                 for h in specs]
+    return specs
+
+
+def is_local_host(hostname: str) -> bool:
+    if hostname in ("localhost", "127.0.0.1", socket.gethostname()):
+        return True
+    try:
+        return socket.gethostbyname(hostname) in local_addresses()
+    except OSError:
+        return False
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _forwarded_env() -> dict[str, str]:
+    env = {}
+    for k, v in os.environ.items():
+        if k.startswith(_FORWARD_PREFIXES):
+            env[k] = v
+    return env
+
+
+def worker_env(slot: hosts_mod.SlotInfo, *, coordinator_addr: str,
+               coordinator_port: int, kv_addr: str, kv_port: int,
+               secret: str, extra: dict | None = None) -> dict[str, str]:
+    """Seed one worker's env (reference seeds HOROVOD_RANK/... at
+    ``gloo_run.py:65-101,201-226``)."""
+    env = _forwarded_env()
+    env.update({
+        "HVD_RANK": str(slot.rank),
+        "HVD_SIZE": str(slot.size),
+        "HVD_LOCAL_RANK": str(slot.local_rank),
+        "HVD_LOCAL_SIZE": str(slot.local_size),
+        "HVD_CROSS_RANK": str(slot.cross_rank),
+        "HVD_CROSS_SIZE": str(slot.cross_size),
+        "HVD_PROCESS_ID": str(slot.rank),
+        "HVD_NUM_PROCESSES": str(slot.size),
+        "HVD_COORDINATOR_ADDR": coordinator_addr,
+        "HVD_COORDINATOR_PORT": str(coordinator_port),
+        "HVD_KV_ADDR": kv_addr,
+        "HVD_KV_PORT": str(kv_port),
+        "HVD_SECRET_KEY": secret,
+        "HVD_HOSTNAME": slot.hostname,
+    })
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _ssh_command(hostname: str, command: list[str], env: dict[str, str],
+                 ssh_port: int | None, identity_file: str | None) -> list[str]:
+    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+    remote = f"cd {shlex.quote(os.getcwd())} 2>/dev/null; {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    cmd = ["ssh"] + SSH_OPTIONS
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    if identity_file:
+        cmd += ["-i", identity_file]
+    cmd += [hostname, remote]
+    return cmd
+
+
+def spawn_worker(slot: hosts_mod.SlotInfo, command: list[str],
+                 env: dict[str, str], args) -> safe_exec.ExecutedProcess:
+    stdout = stderr = None
+    if args.output_filename:
+        d = os.path.join(args.output_filename, f"rank.{slot.rank}")
+        os.makedirs(d, exist_ok=True)
+        stdout = open(os.path.join(d, "stdout"), "w")
+        stderr = open(os.path.join(d, "stderr"), "w")
+    if is_local_host(slot.hostname):
+        full_env = dict(os.environ)
+        full_env.update(env)
+        return safe_exec.execute(command, env=full_env, index=slot.rank,
+                                 stdout=stdout, stderr=stderr)
+    cmd = _ssh_command(slot.hostname, command, env,
+                       args.ssh_port, args.ssh_identity_file)
+    return safe_exec.execute(cmd, env=dict(os.environ), index=slot.rank,
+                             stdout=stdout, stderr=stderr, shell=False)
+
+
+def check_hosts_ssh(hostnames: list[str], ssh_port=None,
+                    identity_file=None) -> None:
+    """Fail fast when a remote host is unreachable (reference
+    ``_check_all_hosts_ssh_successful``, ``launch.py:58-108``)."""
+    remote = [h for h in hostnames if not is_local_host(h)]
+    failures = []
+
+    def check(h):
+        cmd = ["ssh"] + SSH_OPTIONS + (["-p", str(ssh_port)] if ssh_port else []) \
+            + (["-i", identity_file] if identity_file else []) + [h, "true"]
+        if safe_exec.run(cmd, env=dict(os.environ), prefix_output=False) != 0:
+            failures.append(h)
+
+    threads = [threading.Thread(target=check, args=(h,)) for h in set(remote)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if failures:
+        raise RuntimeError(f"ssh connection failed for hosts: {sorted(failures)}")
+
+
+def run_static(args, command: list[str]) -> int:
+    """Spawn all ranks, wait; first failure tears the job down
+    (reference ``_run_static`` + ``launch_gloo``)."""
+    specs = _resolve_hosts(args)
+    np = args.np or hosts_mod.total_slots(specs)
+    slots = hosts_mod.get_host_assignments(specs, np)
+    check_hosts_ssh([s.hostname for s in slots],
+                    args.ssh_port, args.ssh_identity_file)
+
+    secret = make_secret()
+    kv = KVServer(secret=secret)
+    kv_port = kv.start()
+    all_local = all(is_local_host(s.hostname) for s in slots)
+    my_addr = "127.0.0.1" if all_local else local_addresses()[0]
+    # jax.distributed coordinator lives in rank 0's process on rank 0's host
+    coord_host = slots[0].hostname
+    coord_addr = "127.0.0.1" if all_local else (
+        coord_host if not is_local_host(coord_host) else my_addr)
+    coord_port = args.coordinator_port or _free_port()
+
+    extra = dict(args._config_env)
+    for assignment in args.env:
+        k, _, v = assignment.partition("=")
+        extra[k] = v
+    if args.disable_cache:
+        extra["HVD_CACHE_CAPACITY"] = "0"
+    if args.timeline_filename:
+        extra["HVD_TIMELINE"] = args.timeline_filename
+    if args.autotune:
+        extra["HVD_AUTOTUNE"] = "1"
+
+    procs = []
+    try:
+        for slot in slots:
+            env = worker_env(
+                slot, coordinator_addr=coord_addr, coordinator_port=coord_port,
+                kv_addr=my_addr, kv_port=kv_port, secret=secret, extra=extra)
+            procs.append(spawn_worker(slot, command, env, args))
+        return _supervise(procs, slots, args)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        kv.stop()
+
+
+def _supervise(procs, slots, args) -> int:
+    """Wait for all workers; kill the job on first failure (reference
+    MULTI-process supervision in ``gloo_run.py:114-199``)."""
+    exit_codes: dict[int, int] = {}
+    lock = threading.Lock()
+    failed = threading.Event()
+
+    def waiter(i, p):
+        code = p.wait()
+        with lock:
+            exit_codes[i] = code
+        if code != 0:
+            failed.set()
+
+    threads = [threading.Thread(target=waiter, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+    while True:
+        with lock:
+            if len(exit_codes) == len(procs):
+                break
+        if failed.wait(timeout=0.2):
+            break
+    if failed.is_set():
+        with lock:
+            bad = {slots[i].rank: c for i, c in exit_codes.items() if c != 0}
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        print(f"hvdrun: worker failure, exit codes by rank: {bad}",
+              file=sys.stderr)
+        return next(iter(bad.values()), 1)
+    for t in threads:
+        t.join()
+    return 0
+
+
+def run_commandline(argv=None) -> int:
+    args = parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    if args.verbose:
+        os.environ.setdefault("HVD_LOG_LEVEL", "debug")
+    elastic = args.host_discovery_script or args.min_np or args.max_np
+    if elastic:
+        try:
+            from ..elastic.launch import run_elastic
+        except ImportError as e:
+            print(f"hvdrun: elastic launch unavailable ({e})", file=sys.stderr)
+            return 2
+        return run_elastic(args, command)
+    return run_static(args, command)
+
+
+def main() -> None:  # console entry point
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

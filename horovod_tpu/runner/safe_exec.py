@@ -1,0 +1,126 @@
+"""Process-tree-safe command execution.
+
+TPU-native rebuild of the reference's ``safe_shell_exec``
+(``/root/reference/horovod/runner/common/util/safe_shell_exec.py``): run a
+worker command in its own session, stream its output with a per-rank prefix,
+and guarantee the whole process tree dies with the launcher.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def _kill_tree(pid: int, sig: int) -> None:
+    """Signal a process and all descendants (reference kills the process
+    group + psutil children)."""
+    try:
+        import psutil
+        try:
+            root = psutil.Process(pid)
+        except psutil.NoSuchProcess:
+            return
+        procs = [root] + root.children(recursive=True)
+        for p in procs:
+            try:
+                p.send_signal(sig)
+            except psutil.NoSuchProcess:
+                pass
+    except ImportError:  # pragma: no cover
+        try:
+            os.killpg(os.getpgid(pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def terminate_tree(pid: int) -> None:
+    """SIGTERM the tree, escalate to SIGKILL after a grace period."""
+    _kill_tree(pid, signal.SIGTERM)
+    deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return
+        time.sleep(0.1)
+    _kill_tree(pid, signal.SIGKILL)
+
+
+def _pump(stream, sink, prefix: str, index: int | None,
+          prefix_output: bool) -> None:
+    for raw in iter(stream.readline, b""):
+        line = raw.decode(errors="replace")
+        if prefix_output and index is not None:
+            sink.write(f"[{index}]<{prefix}>:{line}")
+        else:
+            sink.write(line)
+        sink.flush()
+    stream.close()
+
+
+class ExecutedProcess:
+    """Handle to a spawned worker command."""
+
+    def __init__(self, proc: subprocess.Popen, pumps: list[threading.Thread]):
+        self.proc = proc
+        self._pumps = pumps
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def wait(self, timeout: float | None = None) -> int:
+        code = self.proc.wait(timeout)
+        for t in self._pumps:
+            t.join(timeout=1.0)
+        return code
+
+    def poll(self) -> int | None:
+        return self.proc.poll()
+
+    def terminate(self) -> None:
+        terminate_tree(self.proc.pid)
+
+
+def execute(command: str | list[str], env: dict | None = None,
+            index: int | None = None, prefix_output: bool = True,
+            stdout=None, stderr=None, shell: bool | None = None) -> ExecutedProcess:
+    """Spawn ``command`` in a new session with piped, prefix-tagged output
+    (reference ``safe_shell_exec.execute``)."""
+    if shell is None:
+        shell = isinstance(command, str)
+    proc = subprocess.Popen(
+        command, shell=shell, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+    pumps = [
+        threading.Thread(
+            target=_pump,
+            args=(proc.stdout, stdout or sys.stdout, "stdout", index, prefix_output),
+            daemon=True),
+        threading.Thread(
+            target=_pump,
+            args=(proc.stderr, stderr or sys.stderr, "stderr", index, prefix_output),
+            daemon=True),
+    ]
+    for t in pumps:
+        t.start()
+    return ExecutedProcess(proc, pumps)
+
+
+def run(command: str | list[str], env: dict | None = None,
+        index: int | None = None, **kw) -> int:
+    """Execute and wait; on KeyboardInterrupt tear down the tree."""
+    p = execute(command, env=env, index=index, **kw)
+    try:
+        return p.wait()
+    except KeyboardInterrupt:
+        p.terminate()
+        raise

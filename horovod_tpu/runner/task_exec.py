@@ -1,0 +1,40 @@
+"""Worker-side entry for the programmatic ``run()`` API.
+
+The launcher pickles the user function into its KV store; each worker
+fetches it, executes, and puts the per-rank result back (the reference moves
+results through the rendezvous KVStore the same way,
+``/root/reference/horovod/runner/launch.py:598-616``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import cloudpickle
+
+from .http_kv import KVClient
+
+
+def main() -> int:
+    rank = int(os.environ["HVD_RANK"])
+    client = KVClient(os.environ["HVD_KV_ADDR"],
+                      int(os.environ["HVD_KV_PORT"]),
+                      secret=os.environ.get("HVD_SECRET_KEY"))
+    startup_timeout = float(os.environ.get("HVD_START_TIMEOUT", "600"))
+    fn, args, kwargs = cloudpickle.loads(
+        client.wait("exec/fn", timeout=startup_timeout))
+    try:
+        result = fn(*args, **kwargs)
+        payload = cloudpickle.dumps(("ok", result))
+    except BaseException:
+        payload = cloudpickle.dumps(("error", traceback.format_exc()))
+        client.put(f"exec/result/{rank}", payload)
+        return 1
+    client.put(f"exec/result/{rank}", payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

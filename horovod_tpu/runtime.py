@@ -49,6 +49,9 @@ class _RuntimeState:
 
 _state: _RuntimeState | None = None
 _lock = threading.Lock()
+# Bumped on every successful init(); lets cached per-ProcessSet meshes
+# detect a shutdown()/init() cycle and rebuild over fresh device objects.
+_generation = 0
 
 
 def _rank_ordered_devices(devices=None):
@@ -81,11 +84,12 @@ def init(
       devices: explicit device list (testing hook).
       axis_name: mesh axis name used by every collective.
     """
-    global _state
+    global _state, _generation
     with _lock:
         if _state is not None:
             hvd_logging.debug("init() called twice; ignoring")
             return
+        _generation += 1
 
         _maybe_distributed_init()
 
@@ -175,6 +179,11 @@ def shutdown() -> None:
 
 def is_initialized() -> bool:
     return _state is not None
+
+
+def generation() -> int:
+    """Monotonic init() counter (see ProcessSet.mesh cache)."""
+    return _generation
 
 
 def _get() -> _RuntimeState:

@@ -1,0 +1,239 @@
+"""Launcher unit tests, mirroring the reference's ``test/single/test_run.py``
+(arg parsing, host parsing, assignment math, mocked command construction)
+plus live KV-server and local end-to-end programmatic runs."""
+
+import os
+import socket
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import (
+    KVClient, KVServer, get_host_assignments, make_secret, parse_args,
+    parse_hostfile, parse_hosts, run, worker_env,
+)
+from horovod_tpu.runner.hosts import HostParseError, HostSpec, total_slots
+from horovod_tpu.runner.launch import _ssh_command, is_local_host
+
+
+# --- host parsing ----------------------------------------------------------
+
+def test_parse_hosts():
+    specs = parse_hosts("h1:4,h2:4,h3")
+    assert [(s.hostname, s.slots) for s in specs] == [
+        ("h1", 4), ("h2", 4), ("h3", 1)]
+
+
+def test_parse_hosts_invalid():
+    with pytest.raises(HostParseError):
+        parse_hosts("")
+    with pytest.raises(HostParseError):
+        parse_hosts("h1:x")
+    with pytest.raises(HostParseError):
+        parse_hosts("h1:0")
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text(textwrap.dedent("""\
+        # training pod
+        tpu-a slots=4
+        tpu-b slots=2
+        tpu-c
+    """))
+    specs = parse_hostfile(str(f))
+    assert [(s.hostname, s.slots) for s in specs] == [
+        ("tpu-a", 4), ("tpu-b", 2), ("tpu-c", 1)]
+    assert total_slots(specs) == 7
+
+
+# --- assignment math (reference hosts.py semantics) ------------------------
+
+def test_host_assignments_basic():
+    slots = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank) for s in slots] == [
+        ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+    assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+               for s in slots)
+
+
+def test_host_assignments_uneven():
+    slots = get_host_assignments(parse_hosts("a:3,b:1"), 4)
+    a2 = slots[2]
+    assert (a2.hostname, a2.local_rank, a2.cross_size) == ("a", 2, 1)
+    b0 = slots[3]
+    assert (b0.hostname, b0.local_rank, b0.local_size, b0.cross_rank,
+            b0.cross_size) == ("b", 0, 1, 1, 2)
+
+
+def test_host_assignments_partial_fill():
+    slots = get_host_assignments(parse_hosts("a:4,b:4"), 5)
+    assert [s.hostname for s in slots] == ["a"] * 4 + ["b"]
+    assert slots[4].local_size == 1
+
+
+def test_host_assignments_overflow():
+    with pytest.raises(ValueError, match="exceeds total available slots"):
+        get_host_assignments(parse_hosts("a:2"), 3)
+
+
+# --- CLI parsing -----------------------------------------------------------
+
+def test_parse_args_basic():
+    args = parse_args(["-np", "4", "-H", "h1:2,h2:2", "python", "train.py"])
+    assert args.np == 4 and args.hosts == "h1:2,h2:2"
+    assert args.command == ["python", "train.py"]
+
+
+def test_parse_args_config_file(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""\
+        np: 8
+        hosts: "x:4,y:4"
+        params:
+          fusion-threshold-mb: 64
+          cycle-time-ms: 2.5
+        timeline:
+          filename: /tmp/tl.json
+        autotune:
+          enabled: true
+    """))
+    args = parse_args(["--config-file", str(cfg), "cmd"])
+    assert args.np == 8 and args.hosts == "x:4,y:4"
+    assert args._config_env["HVD_FUSION_THRESHOLD"] == str(64 * 1024 * 1024)
+    assert args._config_env["HVD_CYCLE_TIME"] == "2.5"
+    assert args._config_env["HVD_TIMELINE"] == "/tmp/tl.json"
+    assert args._config_env["HVD_AUTOTUNE"] == "1"
+
+
+def test_parse_args_cli_overrides_config(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("np: 8\n")
+    args = parse_args(["-np", "2", "--config-file", str(cfg), "cmd"])
+    assert args.np == 2
+
+
+# --- worker env / ssh command ----------------------------------------------
+
+def test_worker_env_seeding():
+    slot = get_host_assignments(parse_hosts("a:2,b:2"), 4)[2]
+    env = worker_env(slot, coordinator_addr="10.0.0.1", coordinator_port=9778,
+                     kv_addr="10.0.0.9", kv_port=8000, secret="s3cr3t")
+    assert env["HVD_RANK"] == "2" and env["HVD_SIZE"] == "4"
+    assert env["HVD_LOCAL_RANK"] == "0" and env["HVD_CROSS_RANK"] == "1"
+    assert env["HVD_PROCESS_ID"] == "2" and env["HVD_NUM_PROCESSES"] == "4"
+    assert env["HVD_COORDINATOR_ADDR"] == "10.0.0.1"
+    assert env["HVD_SECRET_KEY"] == "s3cr3t"
+
+
+def test_ssh_command_construction():
+    cmd = _ssh_command("remote-host", ["python", "train.py"],
+                       {"HVD_RANK": "1"}, ssh_port=2222,
+                       identity_file="/id_rsa")
+    assert cmd[0] == "ssh"
+    assert "-p" in cmd and "2222" in cmd
+    assert "-i" in cmd and "/id_rsa" in cmd
+    assert cmd[-2] == "remote-host"
+    assert "export HVD_RANK=1;" in cmd[-1]
+    assert "python train.py" in cmd[-1]
+
+
+def test_is_local_host():
+    assert is_local_host("localhost")
+    assert is_local_host("127.0.0.1")
+    assert is_local_host(socket.gethostname())
+    assert not is_local_host("surely-not-this-host.invalid")
+
+
+# --- KV server/client ------------------------------------------------------
+
+def test_kv_roundtrip():
+    server = KVServer(secret=None)
+    port = server.start()
+    try:
+        c = KVClient("127.0.0.1", port)
+        assert c.get("scope/missing") is None
+        c.put("scope/k1", b"v1")
+        c.put("scope/k2", b"v2")
+        assert c.get("scope/k1") == b"v1"
+        assert c.keys("scope") == ["scope/k1", "scope/k2"]
+        c.delete("scope/k1")
+        assert c.get("scope/k1") is None
+        assert c.wait("scope/k2", timeout=1.0) == b"v2"
+        with pytest.raises(TimeoutError):
+            c.wait("scope/never", timeout=0.3)
+    finally:
+        server.stop()
+
+
+def test_kv_signature_rejected():
+    secret = make_secret()
+    server = KVServer(secret=secret)
+    port = server.start()
+    try:
+        good = KVClient("127.0.0.1", port, secret=secret)
+        good.put("s/k", b"payload")
+        assert good.get("s/k") == b"payload"
+        bad = KVClient("127.0.0.1", port, secret="wrong")
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            bad.put("s/evil", b"x")
+    finally:
+        server.stop()
+
+
+# --- end-to-end local programmatic run -------------------------------------
+
+# Worker processes can't import this test module; ship the functions by value.
+import cloudpickle  # noqa: E402
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def _worker_fn(scale):
+    # No jax here: validates launcher plumbing (env seeding, fn shipping,
+    # result collection) without paying distributed-XLA startup per test.
+    rank = int(os.environ["HVD_RANK"])
+    size = int(os.environ["HVD_SIZE"])
+    return {"rank": rank, "size": size, "value": rank * scale,
+            "coord": os.environ["HVD_COORDINATOR_ADDR"]}
+
+
+def test_programmatic_run_local():
+    results = run(_worker_fn, args=(10,), np=2,
+                  env={"JAX_PLATFORMS": "cpu"})
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["size"] == 2 for r in results)
+    assert [r["value"] for r in results] == [0, 10]
+
+
+def _failing_fn():
+    raise RuntimeError("worker exploded")
+
+
+def test_programmatic_run_propagates_failure():
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        run(_failing_fn, np=2, env={"JAX_PLATFORMS": "cpu"})
+
+
+def test_hvdrun_cli_local(tmp_path):
+    """Full hvdrun static launch of a trivial 2-rank command."""
+    from horovod_tpu.runner.launch import run_commandline
+    out = tmp_path / "out"
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['HVD_RANK'], 'of', os.environ['HVD_SIZE'])\n")
+    code = run_commandline(
+        ["-np", "2", "--output-filename", str(out), "--",
+         sys.executable, str(script)])
+    assert code == 0
+    assert "rank 0 of 2" in (out / "rank.0" / "stdout").read_text()
+    assert "rank 1 of 2" in (out / "rank.1" / "stdout").read_text()
+
+
+def test_hvdrun_cli_failure_exit_code(tmp_path):
+    from horovod_tpu.runner.launch import run_commandline
+    code = run_commandline(
+        ["-np", "2", "--", sys.executable, "-c", "import sys; sys.exit(3)"])
+    assert code == 3
