@@ -32,7 +32,7 @@ def run(fn, args=(), kwargs=None, np: int = 1, *, hosts: str | None = None,
     """Run ``fn(*args, **kwargs)`` on ``np`` distributed workers and return
     the per-rank results, rank-ordered (reference ``horovod.run``,
     ``/root/reference/horovod/runner/__init__.py:93-214``)."""
-    from .launch import _free_port, _resolve_hosts
+    from .launch import JobRendezvous, _resolve_hosts, _supervise
 
     kwargs = kwargs or {}
     ns = parse_args(["-np", str(np)] +
@@ -46,50 +46,42 @@ def run(fn, args=(), kwargs=None, np: int = 1, *, hosts: str | None = None,
     specs = _resolve_hosts(ns)
     slots = get_host_assignments(specs, np)
 
-    secret = make_secret()
-    kv = KVServer(secret=secret)
-    kv_port = kv.start()
-    kv.put("exec/fn", cloudpickle.dumps((fn, tuple(args), kwargs)))
-
-    all_local = all(is_local_host(s.hostname) for s in slots)
-    my_addr = "127.0.0.1" if all_local else local_addresses()[0]
-    # jax.distributed coordinator binds inside rank 0's process, so it must
-    # be addressed by rank 0's host (mirrors run_static).
-    coord_host = slots[0].hostname
-    coord_addr = "127.0.0.1" if all_local else (
-        my_addr if is_local_host(coord_host) else coord_host)
-    coord_port = _free_port()
+    rdv = JobRendezvous(slots)
+    rdv.kv.put("exec/fn", cloudpickle.dumps((fn, tuple(args), kwargs)))
     command = [sys.executable, "-m", "horovod_tpu.runner.task_exec"]
 
     procs = []
     try:
         for slot in slots:
-            wenv = worker_env(
-                slot, coordinator_addr=coord_addr, coordinator_port=coord_port,
-                kv_addr=my_addr, kv_port=kv_port, secret=secret,
-                extra={**(env or {}),
-                       "HVD_START_TIMEOUT": str(start_timeout)})
+            wenv = rdv.worker_env(
+                slot, extra={**(env or {}),
+                             "HVD_START_TIMEOUT": str(start_timeout)})
             procs.append(spawn_worker(slot, command, wenv, ns))
-        # start_timeout bounds job startup only; a healthy worker may run
-        # indefinitely, so the overall wait is unbounded.
-        codes = [p.wait() for p in procs]
-        results = []
-        for slot in slots:
-            raw = kv.get(f"exec/result/{slot.rank}")
-            if raw is None:
-                raise RuntimeError(
-                    f"rank {slot.rank} produced no result "
-                    f"(exit code {codes[slot.rank]})")
-            status, value = cloudpickle.loads(raw)
+        # _supervise waits for all workers and tears the job down on the
+        # first non-zero exit, so one dead rank can't hang the others
+        # (start_timeout only bounds startup; healthy workers run unbounded).
+        code = _supervise(procs, slots, ns)
+        # Collect every rank's payload first: when _supervise tears the job
+        # down on a mid-rank failure, earlier ranks may have no result — the
+        # failing rank's stored traceback is the error worth surfacing.
+        payloads = {slot.rank: rdv.kv.get(f"exec/result/{slot.rank}")
+                    for slot in slots}
+        decoded = {r: cloudpickle.loads(raw)
+                   for r, raw in payloads.items() if raw is not None}
+        for r in sorted(decoded):
+            status, value = decoded[r]
             if status == "error":
-                raise RuntimeError(f"rank {slot.rank} failed:\n{value}")
-            results.append(value)
-        return results
+                raise RuntimeError(f"rank {r} failed:\n{value}")
+        missing = sorted(r for r, raw in payloads.items() if raw is None)
+        if missing:
+            raise RuntimeError(
+                f"ranks {missing} produced no result (job exit code {code})")
+        return [decoded[slot.rank][1] for slot in slots]
     finally:
         for p in procs:
             if p.poll() is None:
                 p.terminate()
-        kv.stop()
+        rdv.stop()
 
 
 __all__ = [

@@ -11,6 +11,7 @@ launcher's per-job secret, mirroring the reference's signed network messages
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import hmac
 import http.server
@@ -217,7 +218,8 @@ class KVClient:
             time.sleep(poll_interval)
 
 
-def local_addresses() -> list[str]:
+@functools.lru_cache(maxsize=1)
+def _local_addresses_cached() -> tuple[str, ...]:
     """Routable addresses of this host (reference NIC discovery,
     ``driver_service.py:122-193``, radically simplified: on TPU pods the
     fabric is homogeneous so the default-route interface is correct)."""
@@ -240,4 +242,8 @@ def local_addresses() -> list[str]:
         addrs.append(hostname_ip)
     if "127.0.0.1" not in addrs:
         addrs.append("127.0.0.1")
-    return addrs
+    return tuple(addrs)
+
+
+def local_addresses() -> list[str]:
+    return list(_local_addresses_cached())

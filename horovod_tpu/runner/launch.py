@@ -16,6 +16,7 @@ mirrors ``_run_elastic`` (``launch.py:623-672``) and is implemented in
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import shlex
 import socket
@@ -93,19 +94,43 @@ def parse_args(argv=None):
 
 
 def _explicit_dests(argv, parser) -> set:
-    """Dest names of options actually present on the command line."""
+    """Dest names of launcher options actually present on the command line.
+
+    Scanning stops at ``--`` or at the first token that starts the training
+    command, so flag lookalikes inside the command (e.g. the user's own
+    ``--verbose``) are not misclassified as launcher options."""
     explicit = set()
-    opt_to_dest = {}
+    opt_actions = {}
     for action in parser._actions:
         for opt in action.option_strings:
-            opt_to_dest[opt] = action.dest
-    for tok in argv:
+            opt_actions[opt] = action
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
         if tok == "--":
             break
         if tok.startswith("-"):
             opt = tok.split("=", 1)[0]
-            if opt in opt_to_dest:
-                explicit.add(opt_to_dest[opt])
+            action = opt_actions.get(opt)
+            if action is None and opt.startswith("--"):
+                # argparse accepts unambiguous long-option abbreviations
+                matches = {a for o, a in opt_actions.items()
+                           if o.startswith(opt)}
+                if len(matches) == 1:
+                    action = next(iter(matches))
+            if action is None:
+                break  # unknown flag: the training command has started
+            explicit.add(action.dest)
+            consumes_value = ("=" not in tok
+                              and not isinstance(action, (
+                                  argparse._StoreTrueAction,
+                                  argparse._StoreFalseAction,
+                                  argparse._CountAction,
+                                  argparse._HelpAction,
+                                  argparse._VersionAction)))
+            i += 2 if consumes_value else 1
+            continue
+        break  # first positional token: the training command has started
     return explicit
 
 
@@ -124,13 +149,21 @@ def _resolve_hosts(args) -> list[hosts_mod.HostSpec]:
     return specs
 
 
+_is_local_cache: dict[str, bool] = {}
+
+
 def is_local_host(hostname: str) -> bool:
     if hostname in ("localhost", "127.0.0.1", socket.gethostname()):
         return True
+    cached = _is_local_cache.get(hostname)
+    if cached is not None:
+        return cached
     try:
-        return socket.gethostbyname(hostname) in local_addresses()
+        result = socket.gethostbyname(hostname) in local_addresses()
     except OSError:
-        return False
+        return False  # transient resolver failure: do NOT memoize
+    _is_local_cache[hostname] = result
+    return result
 
 
 def _free_port() -> int:
@@ -146,6 +179,15 @@ def _forwarded_env() -> dict[str, str]:
     for k, v in os.environ.items():
         if k.startswith(_FORWARD_PREFIXES):
             env[k] = v
+    # Make sure workers can import this package even when it is not
+    # pip-installed and the worker script lives elsewhere (reference relies
+    # on horovod being installed on every host; we forward the import root).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    parts = os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if pkg_root not in parts:
+        parts.insert(0, pkg_root)
+    env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
     return env
 
 
@@ -176,11 +218,22 @@ def worker_env(slot: hosts_mod.SlotInfo, *, coordinator_addr: str,
     return env
 
 
+# Env vars whose values must never appear in an ssh argv (visible to every
+# local user via ps). The reference excludes the secret from ssh-exported env
+# the same way (``runner/common/util/env.py:24`` IGNORE_REGEXES); we deliver
+# it over the ssh channel's stdin instead.
+_SECRET_ENV_VARS = ("HVD_SECRET_KEY",)
+
+
 def _ssh_command(hostname: str, command: list[str], env: dict[str, str],
                  ssh_port: int | None, identity_file: str | None) -> list[str]:
-    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
-    remote = f"cd {shlex.quote(os.getcwd())} 2>/dev/null; {exports} " + \
-        " ".join(shlex.quote(c) for c in command)
+    public_env = {k: v for k, v in env.items() if k not in _SECRET_ENV_VARS}
+    exports = " ".join(f"export {k}={shlex.quote(v)};"
+                       for k, v in public_env.items())
+    secret_reads = " ".join(f"IFS= read -r {k}; export {k};"
+                            for k in _SECRET_ENV_VARS if k in env)
+    remote = (f"cd {shlex.quote(os.getcwd())} 2>/dev/null; {secret_reads} "
+              f"{exports} " + " ".join(shlex.quote(c) for c in command))
     cmd = ["ssh"] + SSH_OPTIONS
     if ssh_port:
         cmd += ["-p", str(ssh_port)]
@@ -193,20 +246,25 @@ def _ssh_command(hostname: str, command: list[str], env: dict[str, str],
 def spawn_worker(slot: hosts_mod.SlotInfo, command: list[str],
                  env: dict[str, str], args) -> safe_exec.ExecutedProcess:
     stdout = stderr = None
+    owned = []
     if args.output_filename:
         d = os.path.join(args.output_filename, f"rank.{slot.rank}")
         os.makedirs(d, exist_ok=True)
         stdout = open(os.path.join(d, "stdout"), "w")
         stderr = open(os.path.join(d, "stderr"), "w")
+        owned = [stdout, stderr]
     if is_local_host(slot.hostname):
         full_env = dict(os.environ)
         full_env.update(env)
         return safe_exec.execute(command, env=full_env, index=slot.rank,
-                                 stdout=stdout, stderr=stderr)
+                                 stdout=stdout, stderr=stderr, owned_files=owned)
     cmd = _ssh_command(slot.hostname, command, env,
                        args.ssh_port, args.ssh_identity_file)
+    secret_lines = b"".join(env[k].encode() + b"\n"
+                            for k in _SECRET_ENV_VARS if k in env)
     return safe_exec.execute(cmd, env=dict(os.environ), index=slot.rank,
-                             stdout=stdout, stderr=stderr, shell=False)
+                             stdout=stdout, stderr=stderr, shell=False,
+                             stdin_data=secret_lines or None, owned_files=owned)
 
 
 def check_hosts_ssh(hostnames: list[str], ssh_port=None,
@@ -231,6 +289,34 @@ def check_hosts_ssh(hostnames: list[str], ssh_port=None,
         raise RuntimeError(f"ssh connection failed for hosts: {sorted(failures)}")
 
 
+class JobRendezvous:
+    """Shared rendezvous state for one job: the launcher-side KV server and
+    the coordinator address workers will dial."""
+
+    def __init__(self, slots: list[hosts_mod.SlotInfo],
+                 coordinator_port: int = 0):
+        self.secret = make_secret()
+        self.kv = KVServer(secret=self.secret)
+        self.kv_port = self.kv.start()
+        all_local = all(is_local_host(s.hostname) for s in slots)
+        self.kv_addr = "127.0.0.1" if all_local else local_addresses()[0]
+        # jax.distributed coordinator lives in rank 0's process on rank 0's
+        # host, so that is the address every worker must dial.
+        coord_host = slots[0].hostname
+        self.coord_addr = "127.0.0.1" if all_local else (
+            self.kv_addr if is_local_host(coord_host) else coord_host)
+        self.coord_port = coordinator_port or _free_port()
+
+    def worker_env(self, slot, extra=None) -> dict[str, str]:
+        return worker_env(
+            slot, coordinator_addr=self.coord_addr,
+            coordinator_port=self.coord_port, kv_addr=self.kv_addr,
+            kv_port=self.kv_port, secret=self.secret, extra=extra)
+
+    def stop(self) -> None:
+        self.kv.stop()
+
+
 def run_static(args, command: list[str]) -> int:
     """Spawn all ranks, wait; first failure tears the job down
     (reference ``_run_static`` + ``launch_gloo``)."""
@@ -240,16 +326,7 @@ def run_static(args, command: list[str]) -> int:
     check_hosts_ssh([s.hostname for s in slots],
                     args.ssh_port, args.ssh_identity_file)
 
-    secret = make_secret()
-    kv = KVServer(secret=secret)
-    kv_port = kv.start()
-    all_local = all(is_local_host(s.hostname) for s in slots)
-    my_addr = "127.0.0.1" if all_local else local_addresses()[0]
-    # jax.distributed coordinator lives in rank 0's process on rank 0's host
-    coord_host = slots[0].hostname
-    coord_addr = "127.0.0.1" if all_local else (
-        coord_host if not is_local_host(coord_host) else my_addr)
-    coord_port = args.coordinator_port or _free_port()
+    rdv = JobRendezvous(slots, args.coordinator_port)
 
     extra = dict(args._config_env)
     for assignment in args.env:
@@ -265,16 +342,14 @@ def run_static(args, command: list[str]) -> int:
     procs = []
     try:
         for slot in slots:
-            env = worker_env(
-                slot, coordinator_addr=coord_addr, coordinator_port=coord_port,
-                kv_addr=my_addr, kv_port=kv_port, secret=secret, extra=extra)
-            procs.append(spawn_worker(slot, command, env, args))
+            procs.append(spawn_worker(slot, command,
+                                      rdv.worker_env(slot, extra), args))
         return _supervise(procs, slots, args)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.terminate()
-        kv.stop()
+        rdv.stop()
 
 
 def _supervise(procs, slots, args) -> int:

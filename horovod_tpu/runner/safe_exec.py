@@ -55,51 +55,88 @@ def terminate_tree(pid: int) -> None:
 
 def _pump(stream, sink, prefix: str, index: int | None,
           prefix_output: bool) -> None:
-    for raw in iter(stream.readline, b""):
-        line = raw.decode(errors="replace")
-        if prefix_output and index is not None:
-            sink.write(f"[{index}]<{prefix}>:{line}")
-        else:
-            sink.write(line)
-        sink.flush()
-    stream.close()
+    try:
+        for raw in iter(stream.readline, b""):
+            line = raw.decode(errors="replace")
+            if prefix_output and index is not None:
+                sink.write(f"[{index}]<{prefix}>:{line}")
+            else:
+                sink.write(line)
+            sink.flush()
+    except ValueError:
+        pass  # sink force-closed during teardown; drop the tail
+    finally:
+        stream.close()
 
 
 class ExecutedProcess:
     """Handle to a spawned worker command."""
 
-    def __init__(self, proc: subprocess.Popen, pumps: list[threading.Thread]):
+    def __init__(self, proc: subprocess.Popen, pumps: list[threading.Thread],
+                 owned_files: list | None = None):
         self.proc = proc
         self._pumps = pumps
+        self._owned_files = owned_files or []
 
     @property
     def pid(self) -> int:
         return self.proc.pid
 
+    def _close_owned(self) -> None:
+        # Once the process is dead the pipes hit EOF and the pumps finish on
+        # their own; the timeout is just a backstop. Only close the sink
+        # files once every pump that writes to them has exited, so a slow
+        # drain can't race a closed file.
+        deadline = time.monotonic() + 30.0
+        for t in self._pumps:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        if any(t.is_alive() for t in self._pumps):
+            return  # keep files open; retry on the next wait()/poll()
+        for f in self._owned_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._owned_files = []
+
     def wait(self, timeout: float | None = None) -> int:
         code = self.proc.wait(timeout)
-        for t in self._pumps:
-            t.join(timeout=1.0)
+        self._close_owned()
         return code
 
     def poll(self) -> int | None:
-        return self.proc.poll()
+        code = self.proc.poll()
+        if code is not None:
+            self._close_owned()
+        return code
 
     def terminate(self) -> None:
         terminate_tree(self.proc.pid)
+        self._close_owned()
 
 
 def execute(command: str | list[str], env: dict | None = None,
             index: int | None = None, prefix_output: bool = True,
-            stdout=None, stderr=None, shell: bool | None = None) -> ExecutedProcess:
+            stdout=None, stderr=None, shell: bool | None = None,
+            stdin_data: bytes | None = None,
+            owned_files: list | None = None) -> ExecutedProcess:
     """Spawn ``command`` in a new session with piped, prefix-tagged output
-    (reference ``safe_shell_exec.execute``)."""
+    (reference ``safe_shell_exec.execute``). ``stdin_data`` is written to the
+    child's stdin and then stdin is closed — used to hand secrets to remote
+    workers without exposing them in argv."""
     if shell is None:
         shell = isinstance(command, str)
     proc = subprocess.Popen(
         command, shell=shell, env=env,
+        stdin=subprocess.PIPE if stdin_data is not None else subprocess.DEVNULL,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         start_new_session=True)
+    if stdin_data is not None:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass  # child died before reading; its exit code tells the story
     pumps = [
         threading.Thread(
             target=_pump,
@@ -112,7 +149,7 @@ def execute(command: str | list[str], env: dict | None = None,
     ]
     for t in pumps:
         t.start()
-    return ExecutedProcess(proc, pumps)
+    return ExecutedProcess(proc, pumps, owned_files)
 
 
 def run(command: str | list[str], env: dict | None = None,
