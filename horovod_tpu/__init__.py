@@ -84,6 +84,8 @@ from .functions import (
     broadcast_parameters,
     broadcast_variables,
 )
+from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from . import elastic
 from .version import __version__
 
 # Torch-parity aliases (reference exposes in-place variants; jax arrays are
@@ -105,5 +107,6 @@ __all__ = [
     "ProcessSet", "add_process_set", "global_process_set", "remove_process_set",
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
-    "broadcast_variables", "__version__",
+    "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
+    "elastic", "__version__",
 ]

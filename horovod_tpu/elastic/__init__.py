@@ -1,0 +1,90 @@
+"""Elastic (fault-tolerant, auto-scaling) training.
+
+TPU-native rebuild of the reference's elastic subsystem
+(``/root/reference/horovod/common/elastic.py`` and
+``/root/reference/horovod/runner/elastic/``): worker-side state
+commit/restore/sync with host-update interrupts, and a driver that discovers
+hosts, blacklists failures, and resizes the ``jax.distributed`` world
+round-by-round.
+
+Worker usage (mirrors ``hvd.elastic.run`` in the reference)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                 epoch=0, batch=0)
+    state.register_reset_callbacks([rebuild_lr_schedule])
+
+    @hvd.elastic.run
+    def train(state):
+        for state.epoch in range(state.epoch, epochs):
+            for state.batch in range(state.batch, batches):
+                step(state)
+                if state.batch % 10 == 0:
+                    state.commit()
+
+    train(state)
+
+Launch: ``hvdrun -np 2 --min-np 2 --max-np 4
+--host-discovery-script ./discover.sh python train.py``.
+"""
+
+from __future__ import annotations
+
+from ..exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    wrap_internal_errors,
+)
+from .discovery import (
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+)
+from .driver import ElasticDriver, ElasticRendezvous, Results
+from .notification import WorkerNotificationManager, notification_manager
+from .registration import WorkerStateRegistry
+from .state import HostUpdateResult, JaxState, ObjectState, State, run_fn
+
+
+def run(func):
+    """Decorator running ``func(state, ...)`` under elastic recovery
+    (reference ``hvd.elastic.run``): on :class:`HostsUpdatedInterrupt` the
+    worker re-rendezvouses into the new round and syncs state; on
+    :class:`HorovodInternalError` it restores the last commit first."""
+    from .rendezvous import get_worker_rendezvous
+
+    def reset():
+        get_worker_rendezvous().reset()
+
+    wrapped = run_fn(wrap_internal_errors(func), reset)
+
+    def entry(state, *args, **kwargs):
+        try:
+            rdv = get_worker_rendezvous()
+        except RuntimeError:
+            rdv = None  # non-elastic launch: run without the protocol
+        if rdv is not None:
+            # A worker spawned for round R must ignore the notification that
+            # announced R — it is already a member of that round.
+            from .notification import notification_manager
+            notification_manager.register_listener(state)
+            notification_manager.mark_round_joined(rdv.round)
+            rdv.record_ready()
+        result = wrapped(state, *args, **kwargs)
+        if rdv is not None:
+            rdv.record_done()
+        return result
+
+    return entry
+
+
+__all__ = [
+    "ElasticDriver", "ElasticRendezvous", "FixedHosts", "HorovodInternalError",
+    "HostDiscovery", "HostDiscoveryScript", "HostManager", "HostUpdateResult",
+    "HostsUpdatedInterrupt", "JaxState", "ObjectState", "Results", "State",
+    "WorkerNotificationManager", "WorkerStateRegistry",
+    "notification_manager", "run", "run_fn", "wrap_internal_errors",
+]

@@ -1,0 +1,264 @@
+"""Host discovery and blacklist management for elastic jobs.
+
+TPU-native rebuild of ``/root/reference/horovod/runner/elastic/discovery.py``:
+a pluggable :class:`HostDiscovery` source (script / fixed), per-host blacklist
+state with exponential-backoff cooldown and resurrection, and a
+:class:`HostManager` that diffs successive discoveries into
+:class:`~horovod_tpu.elastic.state.HostUpdateResult` updates while keeping a
+stable host ordering (oldest hosts first, so rank 0 stays on a host that
+holds committed state).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..runner import safe_exec
+from ..utils import logging as hvd_logging
+from .state import HostUpdateResult
+
+# Bounds for the blacklist cooldown backoff (reference
+# ``discovery.py:27-31``).
+COOLDOWN_LOWER_LIMIT_S = 1
+COOLDOWN_UPPER_LIMIT_S = 60 * 60
+
+
+class HostState:
+    """Blacklist + cooldown state of one host (reference ``HostState``)."""
+
+    def __init__(self, cooldown_range: tuple[float, float] | None = None):
+        self._event = threading.Event()
+        self._blacklisted = False
+        self._blacklist_count = 0
+        if cooldown_range:
+            lo, hi = cooldown_range
+            if lo < COOLDOWN_LOWER_LIMIT_S:
+                raise ValueError(
+                    f"cooldown lower limit {lo} below minimum "
+                    f"{COOLDOWN_LOWER_LIMIT_S}")
+            if hi > COOLDOWN_UPPER_LIMIT_S:
+                raise ValueError(
+                    f"cooldown upper limit {hi} above maximum "
+                    f"{COOLDOWN_UPPER_LIMIT_S}")
+            self._cooldown_lo, self._cooldown_hi = lo, hi
+        else:
+            self._cooldown_lo = self._cooldown_hi = -1.0
+        self._cooldown_end_ts = 0.0
+
+    def get_event(self) -> threading.Event:
+        if self._event.is_set():
+            self._event = threading.Event()
+        return self._event
+
+    def set_event(self) -> None:
+        self._event.set()
+
+    def _in_cooldown(self, now: float) -> bool:
+        return self._cooldown_end_ts > now
+
+    def blacklist(self) -> None:
+        """Blacklist the host and start (or extend) its cooldown."""
+        self._blacklisted = True
+        now = time.time()
+        if self._in_cooldown(now):
+            return
+        if self._cooldown_lo > 0:
+            self._blacklist_count += 1
+            # exponential backoff with jitter, clamped to the range
+            delay = (self._cooldown_lo * (1 << self._blacklist_count)
+                     + random.uniform(0, 1) * self._cooldown_lo)
+            delay = max(self._cooldown_lo, min(self._cooldown_hi, delay))
+            self._cooldown_end_ts = now + delay
+        self.set_event()
+
+    def whitelist(self) -> None:
+        """End the cooldown and clear the blacklist flag."""
+        self._cooldown_end_ts = 0.0
+        self._blacklisted = False
+
+    def is_blacklisted(self) -> bool:
+        return self._blacklisted
+
+    def is_resurrected(self) -> bool:
+        """Blacklisted host whose cooldown expired: eligible to rejoin."""
+        if self._cooldown_end_ts > 0:
+            return not self._in_cooldown(time.time())
+        return False
+
+
+class DiscoveredHosts:
+    """Immutable snapshot of one discovery result (reference
+    ``DiscoveredHosts``)."""
+
+    def __init__(self, host_slots: dict[str, int],
+                 host_assignment_order: list[str]):
+        self._host_slots = dict(host_slots)
+        self._host_assignment_order = list(host_assignment_order)
+
+    @property
+    def host_slots(self) -> dict[str, int]:
+        return self._host_slots
+
+    @property
+    def available_hosts(self) -> set[str]:
+        return set(self._host_assignment_order)
+
+    @property
+    def host_assignment_order(self) -> list[str]:
+        return self._host_assignment_order
+
+    def get_slots(self, host: str) -> int:
+        return self._host_slots.get(host, 0)
+
+    def count_available_slots(self) -> int:
+        return sum(self.get_slots(h) for h in self._host_assignment_order)
+
+    def update(self, hosts_state) -> "DiscoveredHosts":
+        self._host_assignment_order = [
+            h for h in self._host_assignment_order
+            if not hosts_state[h].is_blacklisted()]
+        return self
+
+    def __str__(self):
+        return (f"slots: {self._host_slots} "
+                f"order: {self._host_assignment_order}")
+
+
+class HostManager:
+    """Tracks the evolving host set and its blacklist (reference
+    ``HostManager``)."""
+
+    def __init__(self, discovery: "HostDiscovery",
+                 cooldown_range: tuple[float, float] | None = None):
+        self._current_hosts = DiscoveredHosts({}, [])
+        self._hosts_state: dict[str, HostState] = {}
+        self._cooldown_range = cooldown_range
+        self._discovery = discovery
+
+    def _state(self, host: str) -> HostState:
+        if host not in self._hosts_state:
+            self._hosts_state[host] = HostState(self._cooldown_range)
+        return self._hosts_state[host]
+
+    def update_available_hosts(self) -> HostUpdateResult:
+        """Run one discovery and diff it against the previous snapshot."""
+        prev_slots = self._current_hosts.host_slots
+        prev_order = self._current_hosts.host_assignment_order
+        host_slots = self._discovery.find_available_hosts_and_slots()
+
+        resurrected = [h for h in host_slots if self._state(h).is_resurrected()]
+        if prev_slots == host_slots and not resurrected:
+            return HostUpdateResult.no_update
+
+        res = HostUpdateResult.no_update
+        for h in prev_slots:
+            if h not in host_slots:
+                res |= HostUpdateResult.removed
+        for h, n in host_slots.items():
+            if h not in prev_slots:
+                res |= HostUpdateResult.added
+            elif n > prev_slots[h]:
+                res |= HostUpdateResult.added
+            elif n < prev_slots[h]:
+                res |= HostUpdateResult.removed
+            elif self._state(h).is_resurrected():
+                res |= HostUpdateResult.added
+
+        available = {h for h in host_slots
+                     if not (self._state(h).is_blacklisted()
+                             and not self._state(h).is_resurrected())}
+        order = self.order_available_hosts(available, prev_order)
+        self._current_hosts = DiscoveredHosts(host_slots, order)
+        for h in resurrected:
+            self._state(h).whitelist()
+        return res
+
+    @property
+    def current_hosts(self) -> DiscoveredHosts:
+        return self._current_hosts.update(self._hosts_state_default())
+
+    def _hosts_state_default(self):
+        class _Default(dict):
+            def __missing__(inner, key):  # noqa: N805
+                return self._state(key)
+        return _Default()
+
+    def blacklist(self, host: str) -> None:
+        if not self._state(host).is_blacklisted():
+            hvd_logging.info("blacklisting failing host: %s", host)
+        self._state(host).blacklist()
+
+    def is_blacklisted(self, host: str) -> bool:
+        return self._state(host).is_blacklisted()
+
+    def has_pending_resurrections(self) -> bool:
+        """Any blacklisted host that will become eligible again after its
+        cooldown (only possible when a cooldown range is configured)."""
+        return any(s.is_blacklisted() and s._cooldown_end_ts > 0
+                   for s in self._hosts_state.values())
+
+    def get_host_event(self, host: str) -> threading.Event:
+        return self._state(host).get_event()
+
+    @staticmethod
+    def order_available_hosts(available_hosts: set[str],
+                              prev_order: list[str]) -> list[str]:
+        """Preserve relative order so the oldest hosts keep the lowest ranks
+        (rank 0 must stay on a host holding committed state)."""
+        order = [h for h in prev_order if h in available_hosts]
+        known = set(order)
+        order.extend(h for h in sorted(available_hosts) if h not in known)
+        return order
+
+
+class HostDiscovery:
+    """Interface: return ``{hostname: slots}`` for currently usable hosts."""
+
+    def find_available_hosts_and_slots(self) -> dict[str, int]:
+        raise NotImplementedError()
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script printing one ``host[:slots]`` per line (reference
+    ``HostDiscoveryScript``; the CLI flag is ``--host-discovery-script``)."""
+
+    def __init__(self, discovery_script: str, default_slots: int = 1):
+        self._script = discovery_script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> dict[str, int]:
+        import io
+        buf = io.StringIO()
+        code = safe_exec.run(self._script, prefix_output=False,
+                             stdout=buf, shell=True)
+        if code != 0:
+            raise RuntimeError(
+                f"host discovery script {self._script!r} failed "
+                f"with exit code {code}")
+        host_slots: dict[str, int] = {}
+        for line in set(buf.getvalue().strip().split("\n")):
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                host, slots = line.rsplit(":", 1)
+                host_slots[host] = int(slots)
+            else:
+                host_slots[line] = self._default_slots
+        return host_slots
+
+
+class FixedHosts(HostDiscovery):
+    """Static (but settable) host set — the unit-test hook (reference
+    ``FixedHosts``, used by ``test_elastic_driver.py``)."""
+
+    def __init__(self, host_slots: dict[str, int]):
+        self._host_slots = dict(host_slots)
+
+    def find_available_hosts_and_slots(self) -> dict[str, int]:
+        return dict(self._host_slots)
+
+    def set(self, host_slots: dict[str, int]) -> None:
+        self._host_slots = dict(host_slots)

@@ -1,0 +1,515 @@
+"""Elastic driver: host discovery loop, round management, worker lifecycle.
+
+TPU-native rebuild of ``/root/reference/horovod/runner/elastic/driver.py``.
+The reference coordinates resets through a worker-count barrier inside
+``WorkerStateRegistry`` plus a gloo re-rendezvous; here the protocol is a
+monotonically increasing **round** published through the launcher's HTTP KV
+store:
+
+1. The discovery thread polls the host set (1 s). On any change — or on a
+   worker failure recorded by the registry — the driver computes the next
+   host assignment (honoring ``min_np``/``max_np`` and the blacklist),
+   publishes round ``R+1`` (slot table + fresh ``jax.distributed``
+   coordinator address) to the KV, and notifies workers.
+2. Existing workers hit the notification at their next ``state.commit()``,
+   raise :class:`HostsUpdatedInterrupt`, fetch round ``R+1``, and
+   re-initialize the jax world against the new coordinator.
+3. The driver spawns worker processes for newly assigned slots and
+   terminates processes whose slot disappeared; a worker whose slot is gone
+   self-exits with :data:`SLOT_LOST_EXIT_CODE`.
+
+Rank 0 stays on the oldest surviving host (``HostManager`` ordering), so the
+post-reset ``state.sync()`` broadcast always originates from a worker holding
+committed state (reference asserts the same invariant,
+``driver.py:246-252``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+import threading
+import time
+
+from ..runner import hosts as hosts_mod
+from ..utils import envs
+from ..utils import logging as hvd_logging
+from .discovery import HostManager
+from .registration import WorkerStateRegistry
+from .state import HostUpdateResult
+
+DISCOVER_HOSTS_FREQUENCY_S = 1.0
+DEFAULT_ELASTIC_TIMEOUT_S = 600
+# A worker exits with this code when its slot vanished in a resize: a clean,
+# expected exit that must be ignored by the registry.
+SLOT_LOST_EXIT_CODE = 66
+
+# Canonical KV key layout for the elastic protocol. Every module (driver,
+# worker rendezvous, notification poller, launcher observer) must use these
+# helpers — the formats are not duplicated anywhere else.
+ROUND_KEY = "elastic/round"
+ROUND_SPEC_KEY = "elastic/round/{}"
+NOTIFY_KEY = "elastic/notify"
+STOP_KEY = "elastic/stop"
+READY_KEY_PREFIX = "elastic/ready/"
+
+
+DONE_KEY_PREFIX = "elastic/done/"
+
+
+def ready_key(round_id: int, host: str, slot: int) -> str:
+    return f"{READY_KEY_PREFIX}{round_id}/{host}/{slot}"
+
+
+def done_key(host: str, slot: int) -> str:
+    return f"{DONE_KEY_PREFIX}{host}/{slot}"
+
+
+def parse_done_key(key: str) -> tuple[str, int] | None:
+    """Return (host, slot) if ``key`` records a completed worker, else None.
+
+    Workers PUT this the moment their training function returns — *before*
+    any jax teardown — so job success is decided by reaching the end of
+    training, not by the process exit code (the distributed-runtime
+    teardown can fatally race when the coordinator process exits first)."""
+    if not key.startswith(DONE_KEY_PREFIX):
+        return None
+    parts = key[len(DONE_KEY_PREFIX):].split("/")
+    if len(parts) != 2:
+        return None
+    try:
+        return parts[0], int(parts[1])
+    except ValueError:
+        return None
+
+
+def parse_ready_key(key: str) -> tuple[str, int] | None:
+    """Return (host, slot) if ``key`` is a readiness record, else None."""
+    if not key.startswith(READY_KEY_PREFIX):
+        return None
+    parts = key[len(READY_KEY_PREFIX):].split("/")
+    if len(parts) != 3:
+        return None
+    _round_id, host, slot = parts
+    try:
+        return host, int(slot)
+    except ValueError:
+        return None
+
+
+def _slot_to_dict(s: hosts_mod.SlotInfo) -> dict:
+    return {"hostname": s.hostname, "rank": s.rank, "size": s.size,
+            "local_rank": s.local_rank, "local_size": s.local_size,
+            "cross_rank": s.cross_rank, "cross_size": s.cross_size}
+
+
+def slot_from_dict(d: dict) -> hosts_mod.SlotInfo:
+    return hosts_mod.SlotInfo(**d)
+
+
+class ElasticRendezvous:
+    """Round publication over the launcher-side KV server (the analog of the
+    reference's ``ElasticRendezvousServer``)."""
+
+    def __init__(self, kv_server):
+        self.kv = kv_server
+        self._round = 0
+
+    @property
+    def round_id(self) -> int:
+        return self._round
+
+    def publish_round(self, slots: list[hosts_mod.SlotInfo],
+                      coord_addr: str, coord_port: int,
+                      update_res: HostUpdateResult) -> int:
+        self._round += 1
+        spec = {
+            "round": self._round,
+            "coord_addr": coord_addr,
+            "coord_port": coord_port,
+            "world_size": len(slots),
+            "slots": [_slot_to_dict(s) for s in slots],
+        }
+        # Order matters: workers wait on ROUND_KEY, so the spec must be
+        # readable before the round number advances.
+        self.kv.put(ROUND_SPEC_KEY.format(self._round), pickle.dumps(spec))
+        self.kv.put(ROUND_KEY, str(self._round).encode())
+        if self._round > 1:
+            # The round id doubles as the notification timestamp: strictly
+            # increasing, so back-to-back rounds can never collide the way
+            # wall-clock stamps can.
+            self.kv.put(NOTIFY_KEY,
+                        pickle.dumps((self._round, int(update_res))))
+        return self._round
+
+    def stop(self) -> None:
+        self.kv.put(STOP_KEY, b"1")
+
+
+class Results:
+    def __init__(self, error_message, worker_results):
+        self.error_message = error_message
+        self.worker_results = worker_results
+
+
+class ElasticDriver:
+    """Drives an elastic job (reference ``ElasticDriver``)."""
+
+    def __init__(self, rendezvous: ElasticRendezvous, discovery,
+                 min_np: int, max_np: int | None = None,
+                 timeout: float | None = None, reset_limit: int | None = None,
+                 cooldown_range=None, verbose: int = 0):
+        self._rendezvous = rendezvous
+        self._host_manager = HostManager(discovery, cooldown_range)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._verbose = verbose
+        self._timeout = timeout or envs.get_int(
+            envs.ELASTIC_TIMEOUT, DEFAULT_ELASTIC_TIMEOUT_S)
+
+        self._host_assignments: dict[str, list[hosts_mod.SlotInfo]] = {}
+        self._rank_assignments: dict[int, hosts_mod.SlotInfo] = {}
+        self._world_size = 0
+
+        self._wait_hosts_cond = threading.Condition()
+        # Serializes round transitions: _activate_workers can be entered from
+        # the discovery thread (host change) and from worker-exit waiter
+        # threads (registry resume) concurrently; rounds must be atomic.
+        self._round_lock = threading.RLock()
+        self._create_worker_fn = None
+        self._active_procs: dict[tuple[str, int], object] = {}
+        self._proc_lock = threading.Lock()
+        self._success = False
+
+        self._worker_registry = WorkerStateRegistry(
+            self, self._host_manager, reset_limit=reset_limit)
+        self._error_message: str | None = None
+        self._worker_results: dict[str, tuple[int, float]] = {}
+        self._result_threads: list[threading.Thread] = []
+        self._shutdown = threading.Event()
+
+        self._discovery_thread = threading.Thread(
+            target=self._discover_hosts, daemon=True, name="hvd-elastic-disco")
+        self._discovery_thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, np: int, create_worker_fn) -> None:
+        """Begin the job: wait for ``np`` slots and launch the first round.
+
+        ``create_worker_fn(slot_info, round_spec)`` must spawn the worker
+        process and return a handle with ``wait()/poll()/terminate()``.
+        """
+        self._create_worker_fn = create_worker_fn
+        self._activate_workers(np)
+
+    def resume(self) -> None:
+        """Start a new round after failures/blacklisting (registry hook)."""
+        self._activate_workers(self._min_np)
+
+    def stop(self, error_message: str | None = None,
+             success: bool = False) -> None:
+        if error_message:
+            self._error_message = error_message
+        if success:
+            self._success = True
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        self._rendezvous.stop()
+        with self._wait_hosts_cond:
+            self._wait_hosts_cond.notify_all()
+        if not success:
+            # Failure: tear everything down now. On success, workers are
+            # left to exit naturally (they may still be saving checkpoints
+            # or running post-training work after recording done);
+            # ``join`` terminates stragglers after a grace period.
+            self._terminate_active()
+
+    def _terminate_active(self) -> None:
+        with self._proc_lock:
+            procs = list(self._active_procs.values())
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    def finished(self) -> bool:
+        return self._shutdown.is_set()
+
+    GRACE_PERIOD_S = 60.0
+
+    def join(self) -> None:
+        """Block until the job stops and all exit handlers ran. After a
+        success-stop, workers get :data:`GRACE_PERIOD_S` to finish their
+        post-training work before stragglers are terminated."""
+        while not self._shutdown.wait(0.2):
+            pass
+        deadline = time.monotonic() + self.GRACE_PERIOD_S
+        while time.monotonic() < deadline:
+            with self._proc_lock:
+                if not self._active_procs:
+                    break
+            time.sleep(0.2)
+        self._terminate_active()
+        for t in list(self._result_threads):
+            t.join(timeout=30)
+        self._discovery_thread.join(timeout=5)
+
+    def get_results(self) -> Results:
+        return Results(self._error_message, dict(self._worker_results))
+
+    @property
+    def succeeded(self) -> bool:
+        """True when the job stopped because a worker completed successfully
+        — failures in *earlier* rounds that elastic recovery absorbed do not
+        count against the job."""
+        return self._success
+
+    # -- queries (reference driver API) ------------------------------------
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def local_size(self, host: str) -> int:
+        return len(self._host_assignments.get(host, []))
+
+    def get_slot_info(self, host: str, slot: int):
+        if not self.has_rank_assignment(host, slot):
+            return None
+        return self._host_assignments[host][slot]
+
+    def get_coordinator_info(self):
+        return self._rank_assignments.get(0)
+
+    def has_rank_assignment(self, host: str, slot: int) -> bool:
+        if self._host_manager.is_blacklisted(host):
+            return False
+        return (host in self._host_assignments
+                and len(self._host_assignments[host]) > slot)
+
+    @property
+    def host_assignments(self):
+        return self._host_assignments
+
+    @property
+    def registry(self) -> WorkerStateRegistry:
+        return self._worker_registry
+
+    def record_ready(self, host: str, slot: int) -> None:
+        self._worker_registry.record_ready(host, slot)
+
+    # -- internals ---------------------------------------------------------
+
+    def wait_for_available_slots(self, min_np: int, min_hosts: int = 1):
+        deadline = time.monotonic() + self._timeout
+        with self._wait_hosts_cond:
+            while True:
+                current_hosts = self._host_manager.current_hosts
+                if (current_hosts.count_available_slots() >= min_np
+                        and len(current_hosts.available_hosts) >= min_hosts):
+                    return current_hosts
+                if self._shutdown.is_set():
+                    raise RuntimeError(
+                        "job has been shut down, see above errors")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out waiting for at least {min_np} slots "
+                        f"on {min_hosts}+ hosts; only "
+                        f"{current_hosts.count_available_slots()} available")
+                self._wait_hosts_cond.wait(min(remaining, 1.0))
+
+    def _activate_workers(self, min_np: int) -> None:
+        with self._round_lock:
+            hvd_logging.info("elastic: waiting for %d+ slots", min_np)
+            current_hosts = self.wait_for_available_slots(min_np)
+            update_res, pending, stale = self._update_host_assignments(
+                current_hosts)
+            self._worker_registry.reset(self.world_size())
+            self._stop_stale_workers(stale)
+            self._start_worker_processes(pending)
+
+    def _discover_hosts(self) -> None:
+        first_update = True
+        while not self._shutdown.is_set():
+            with self._wait_hosts_cond:
+                try:
+                    update_res = self._host_manager.update_available_hosts()
+                except Exception as e:
+                    # Catch everything: a transiently malformed discovery
+                    # output (e.g. ValueError from int()) must not kill the
+                    # discovery thread and freeze elasticity.
+                    if first_update:
+                        hvd_logging.error("initial host discovery failed: %s",
+                                          e)
+                        self._error_message = str(e)
+                        self._shutdown.set()
+                        self._wait_hosts_cond.notify_all()
+                        return
+                    hvd_logging.warning("host discovery failed: %s", e)
+                    update_res = HostUpdateResult.no_update
+                if update_res != HostUpdateResult.no_update:
+                    self._wait_hosts_cond.notify_all()
+            if (update_res != HostUpdateResult.no_update and not first_update
+                    and self._create_worker_fn is not None):
+                self._on_hosts_updated(update_res)
+            first_update = False
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_S)
+
+    def _on_hosts_updated(self, update_res: HostUpdateResult) -> None:
+        """Host set changed mid-run: open a new round if assignments move.
+
+        Runs on the discovery thread; any unexpected error here must stop
+        the job loudly rather than silently killing the thread (a dead
+        discovery thread would freeze elasticity for the rest of the run).
+        """
+        try:
+            # The assignment comparison must run under the round lock: a
+            # concurrent registry-driven resume() may be publishing a round
+            # for this very host change, and comparing against stale
+            # assignments would publish a redundant duplicate round.
+            with self._round_lock:
+                current_hosts = self._host_manager.current_hosts
+                if current_hosts.count_available_slots() < self._min_np:
+                    hvd_logging.warning(
+                        "hosts changed but fewer than min_np=%d slots "
+                        "available; waiting", self._min_np)
+                    return
+                try:
+                    next_assignments = self._compute_assignments(
+                        current_hosts)
+                except ValueError as e:
+                    hvd_logging.warning("cannot assign hosts yet: %s", e)
+                    return
+                if {h: [s.rank for s in slots]
+                        for h, slots in next_assignments[0].items()} == \
+                        {h: [s.rank for s in slots]
+                         for h, slots in self._host_assignments.items()}:
+                    hvd_logging.debug(
+                        "host change does not alter assignments")
+                    return
+                self._activate_workers(self._min_np)
+        except Exception as e:
+            hvd_logging.exception("failed to apply host update")
+            self.stop(error_message=f"host update failed: {e}")
+
+    def _compute_assignments(self, current_hosts):
+        host_list = [hosts_mod.HostSpec(h, current_hosts.get_slots(h))
+                     for h in current_hosts.host_assignment_order]
+        assignment_list = hosts_mod.elastic_host_assignments(
+            host_list, self._min_np, self._max_np)
+        by_host: dict[str, list[hosts_mod.SlotInfo]] = {}
+        for slot_info in assignment_list:
+            by_host.setdefault(slot_info.hostname, []).append(slot_info)
+        return by_host, assignment_list
+
+    def _update_host_assignments(self, current_hosts):
+        active = set(self._active_slots())
+        by_host, assignment_list = self._compute_assignments(current_hosts)
+
+        if self._host_assignments:
+            prev_hosts = set(self._host_assignments)
+            if not prev_hosts & set(by_host):
+                raise RuntimeError(
+                    "no hosts from the previous round remain; committed "
+                    "state cannot be broadcast to the new workers")
+
+        prev_world = self._world_size
+        self._host_assignments = by_host
+        self._rank_assignments = {s.rank: s for s in assignment_list}
+        self._world_size = len(assignment_list)
+
+        update_res = HostUpdateResult.no_update
+        if self._world_size > prev_world:
+            update_res |= HostUpdateResult.added
+        if prev_world and self._world_size < prev_world:
+            update_res |= HostUpdateResult.removed
+        if prev_world and self._world_size == prev_world:
+            update_res |= HostUpdateResult.mixed
+
+        coord_host = assignment_list[0].hostname
+        coord_addr, coord_port = self._coordinator_endpoint(coord_host)
+        self._current_spec_round = self._rendezvous.publish_round(
+            assignment_list, coord_addr, coord_port, update_res)
+
+        assigned = {(s.hostname, s.local_rank) for s in assignment_list}
+        pending = [s for s in assignment_list
+                   if (s.hostname, s.local_rank) not in active]
+        stale = [key for key in active if key not in assigned]
+        return update_res, pending, stale
+
+    def _coordinator_endpoint(self, coord_host: str) -> tuple[str, int]:
+        from ..runner.launch import _free_port, is_local_host
+        from ..runner.http_kv import local_addresses
+        if is_local_host(coord_host):
+            addr = "127.0.0.1" if all(
+                is_local_host(h) for h in self._host_assignments) else \
+                local_addresses()[0]
+            return addr, _free_port()
+        # Remote coordinator: the driver cannot probe free ports there, so
+        # pick a random high port; collisions surface as rendezvous errors
+        # and trigger the next round.
+        return coord_host, random.randint(29500, 64000)
+
+    def _active_slots(self):
+        with self._proc_lock:
+            return list(self._active_procs.keys())
+
+    def _stop_stale_workers(self, stale_keys) -> None:
+        for key in stale_keys:
+            with self._proc_lock:
+                proc = self._active_procs.get(key)
+            if proc is not None and proc.poll() is None:
+                hvd_logging.info("terminating worker %s[%d]: slot removed",
+                                 *key)
+                proc.terminate()
+
+    def _start_worker_processes(self, pending_slots) -> None:
+        spec_round = self._rendezvous.round_id
+        for slot_info in pending_slots:
+            hvd_logging.info("starting worker %s[%d] (rank %d, round %d)",
+                             slot_info.hostname, slot_info.local_rank,
+                             slot_info.rank, spec_round)
+            self._start_worker_process(slot_info, spec_round)
+
+    def _start_worker_process(self, slot_info, spec_round: int) -> None:
+        proc = self._create_worker_fn(slot_info, spec_round)
+        key = (slot_info.hostname, slot_info.local_rank)
+        with self._proc_lock:
+            self._active_procs[key] = proc
+
+        def waiter():
+            exit_code = proc.wait()
+            with self._proc_lock:
+                if self._active_procs.get(key) is proc:
+                    del self._active_procs[key]
+            self._handle_worker_exit(slot_info, exit_code)
+
+        t = threading.Thread(target=waiter, daemon=True,
+                             name=f"hvd-elastic-wait-{slot_info.rank}")
+        t.start()
+        self._result_threads.append(t)
+
+    def _handle_worker_exit(self, slot_info, exit_code: int) -> None:
+        timestamp = time.time()
+        name = f"{slot_info.hostname}[{slot_info.local_rank}]"
+        if exit_code == SLOT_LOST_EXIT_CODE:
+            hvd_logging.debug("worker %s exited: slot removed", name)
+            return
+        if not self.has_rank_assignment(slot_info.hostname,
+                                        slot_info.local_rank):
+            hvd_logging.debug("ignoring exit of unassigned worker %s", name)
+            return
+        if self.finished() and exit_code != 0:
+            # Non-zero exit after the job already stopped is almost always
+            # the driver's own SIGTERM during teardown, not a failure.
+            hvd_logging.debug("ignoring post-shutdown exit of %s (%d)",
+                              name, exit_code)
+            return
+        self._worker_results.setdefault(name, (exit_code, timestamp))
+        if exit_code == 0:
+            self._worker_registry.record_success(slot_info.hostname,
+                                                 slot_info.local_rank)
+        else:
+            self._worker_registry.record_failure(slot_info.hostname,
+                                                 slot_info.local_rank)

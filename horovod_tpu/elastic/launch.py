@@ -1,0 +1,133 @@
+"""Elastic launch entry for ``hvdrun``.
+
+TPU-native rebuild of the reference's ``_run_elastic`` + ``gloo_run_elastic``
+(``/root/reference/horovod/runner/launch.py:623-672``,
+``/root/reference/horovod/runner/gloo_run.py:301-350``): build the discovery
+source, stand up the KV server + elastic rendezvous, and hand worker
+spawning to :class:`~horovod_tpu.elastic.driver.ElasticDriver`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..runner import hosts as hosts_mod
+from ..runner import launch as launch_mod
+from ..runner.http_kv import KVServer, local_addresses, make_secret
+from ..utils import logging as hvd_logging
+from .discovery import FixedHosts, HostDiscoveryScript
+from .driver import (
+    ElasticDriver,
+    ElasticRendezvous,
+    parse_done_key,
+    parse_ready_key,
+)
+
+
+def _build_discovery(args):
+    if args.host_discovery_script:
+        return HostDiscoveryScript(args.host_discovery_script,
+                                   default_slots=args.slots_per_host or 1)
+    # Fixed hosts still benefit from elastic mode: failed hosts are
+    # blacklisted and the job continues while >= min_np slots remain.
+    specs = launch_mod._resolve_hosts(args)
+    return FixedHosts({h.hostname: h.slots for h in specs})
+
+
+def run_elastic(args, command: list[str]) -> int:
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np
+    discovery = _build_discovery(args)
+
+    secret = make_secret()
+
+    driver_holder: list[ElasticDriver] = []
+
+    def on_put(key: str, _payload: bytes) -> None:
+        # Worker readiness and completion flow through KV PUTs (the
+        # reference's rendezvous server calls driver.record_ready the same
+        # way; completion-by-KV decouples job success from the exit-code
+        # race during distributed-runtime teardown).
+        if not driver_holder:
+            return
+        parsed = parse_ready_key(key)
+        if parsed is not None:
+            driver_holder[0].record_ready(*parsed)
+            return
+        parsed = parse_done_key(key)
+        if parsed is not None:
+            driver_holder[0].registry.record_success(*parsed)
+
+    kv = KVServer(secret=secret, on_put=on_put)
+    kv_port = kv.start()
+    kv_addr_candidates = local_addresses()
+    kv_addr = kv_addr_candidates[0]
+
+    rendezvous = ElasticRendezvous(kv)
+    from ..utils import envs
+    driver = ElasticDriver(
+        rendezvous, discovery, min_np, max_np,
+        # HVD_ELASTIC_TIMEOUT wins over the CLI default so driver and
+        # workers agree on how long host replacement may take.
+        timeout=envs.get_int(envs.ELASTIC_TIMEOUT, int(args.start_timeout)),
+        reset_limit=getattr(args, "reset_limit", None),
+        cooldown_range=(tuple(args.blacklist_cooldown_range)
+                        if getattr(args, "blacklist_cooldown_range", None)
+                        else None),
+        verbose=1 if args.verbose else 0)
+    driver_holder.append(driver)
+
+    extra_base = dict(args._config_env)
+    for assignment in args.env:
+        k, _, v = assignment.partition("=")
+        extra_base[k] = v
+
+    spec_cache: dict[int, dict] = {}
+
+    def _round_spec(spec_round: int) -> dict:
+        import pickle
+
+        from .driver import ROUND_SPEC_KEY
+        if spec_round not in spec_cache:
+            spec_cache[spec_round] = pickle.loads(
+                kv.get(ROUND_SPEC_KEY.format(spec_round)))
+        return spec_cache[spec_round]
+
+    def create_worker_fn(slot_info: hosts_mod.SlotInfo, spec_round: int):
+        spec = _round_spec(spec_round)
+        all_local = all(
+            launch_mod.is_local_host(s["hostname"]) for s in spec["slots"])
+        env = launch_mod.worker_env(
+            slot_info,
+            coordinator_addr=spec["coord_addr"],
+            coordinator_port=spec["coord_port"],
+            kv_addr="127.0.0.1" if all_local else kv_addr,
+            kv_port=kv_port,
+            secret=secret,
+            extra={**extra_base,
+                   "HVD_ELASTIC": "1",
+                   "HVD_ELASTIC_ROUND": str(spec_round)})
+        return launch_mod.spawn_worker(slot_info, command, env, args)
+
+    try:
+        driver.start(args.np or min_np, create_worker_fn)
+        driver.join()
+        results = driver.get_results()
+    finally:
+        driver.stop()
+        kv.stop()
+
+    if results.error_message:
+        print(f"hvdrun elastic: {results.error_message}", file=sys.stderr)
+        return 1
+    if driver.succeeded:
+        # Elastic recovery absorbed any earlier-round failures: the job
+        # completed, so earlier non-zero exits must not fail the run.
+        hvd_logging.info("elastic job finished: %s", results.worker_results)
+        return 0
+    failures = {name: code for name, (code, _ts)
+                in results.worker_results.items() if code != 0}
+    if failures:
+        print(f"hvdrun elastic: worker failures: {failures}", file=sys.stderr)
+        return next(iter(failures.values()))
+    return 0

@@ -1,0 +1,110 @@
+"""Worker-side host-change notification.
+
+TPU-native rebuild of the reference's ``WorkerNotificationManager`` /
+``WorkerNotificationService`` (``/root/reference/horovod/runner/elastic/
+worker.py:46-119``). The reference runs a TCP server inside every worker and
+the driver pushes ``HostsUpdatedRequest`` to the coordinator; here workers
+*poll* the launcher's HTTP KV store for the ``elastic/notify`` key instead —
+no per-worker listening sockets, and global consistency still comes from the
+rank-0 broadcast inside ``State.check_host_updates``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+from ..utils import envs
+from ..utils import logging as hvd_logging
+from .state import HostUpdateResult
+
+POLL_INTERVAL_S = 0.5
+
+
+def _notify_key() -> str:
+    from .driver import NOTIFY_KEY
+    return NOTIFY_KEY
+
+
+class WorkerNotificationManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = set()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._client = None
+        self._last_timestamp = 0
+
+    def init(self, kv_client=None):
+        """Start the poll thread (idempotent). Without launcher-seeded KV env
+        (non-elastic runs) this is a no-op, mirroring the reference's early
+        return when no rendezvous address is set (``worker.py:57-60``)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            if kv_client is None:
+                addr = envs.get(envs.KV_ADDR)
+                if not addr:
+                    return
+                from ..runner.http_kv import KVClient
+                kv_client = KVClient(addr, envs.get_int(envs.KV_PORT, 0),
+                                     secret=envs.get(envs.SECRET_KEY))
+            self._client = kv_client
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="hvd-elastic-notify")
+            self._thread.start()
+
+    def register_listener(self, listener):
+        with self._lock:
+            self._listeners.add(listener)
+
+    def mark_round_joined(self, round_id: int) -> None:
+        """Suppress notifications for rounds the worker has already joined.
+
+        Notification timestamps are round ids; once a worker re-rendezvouses
+        into round R, the (late-polled) notification that *announced* R is
+        stale — delivering it would trigger a spurious interrupt and leave
+        the worker waiting for a round R+1 that never comes."""
+        with self._lock:
+            if round_id > self._last_timestamp:
+                self._last_timestamp = round_id
+            for listener in self._listeners:
+                if round_id > getattr(listener, "_last_updated_timestamp", 0):
+                    listener._last_updated_timestamp = round_id
+
+    def remove_listener(self, listener):
+        with self._lock:
+            self._listeners.discard(listener)
+
+    def shutdown(self):
+        with self._lock:
+            self._stop.set()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2 * POLL_INTERVAL_S)
+
+    def _poll_loop(self):
+        while not self._stop.wait(POLL_INTERVAL_S):
+            try:
+                raw = self._client.get(_notify_key())
+            except Exception as e:  # launcher gone: stop polling quietly
+                hvd_logging.debug("elastic notify poll failed: %s", e)
+                continue
+            if raw is None:
+                continue
+            try:
+                timestamp, update_res = pickle.loads(raw)
+            except Exception:
+                continue
+            if timestamp <= self._last_timestamp:
+                continue
+            self._last_timestamp = timestamp
+            with self._lock:
+                listeners = list(self._listeners)
+            for listener in listeners:
+                listener.on_hosts_updated(timestamp,
+                                          HostUpdateResult(update_res))
+
+
+notification_manager = WorkerNotificationManager()

@@ -1,0 +1,179 @@
+"""Worker-side elastic rendezvous: fetch the new round, rebuild the world.
+
+The TPU-native analog of the reference's reset path, where workers rebuild
+gloo contexts against the rendezvous server after ``hvd.shutdown()`` /
+``hvd.init()`` (reference ``horovod/torch/elastic/__init__.py`` reset +
+``gloo_context.cc`` re-init). Here a reset is:
+
+1. wait for the KV round counter to advance past our round,
+2. look up this worker's slot (stable ``(hostname, spawn local_rank)`` key)
+   in the new round's slot table — if gone, self-exit with
+   :data:`~horovod_tpu.elastic.driver.SLOT_LOST_EXIT_CODE`,
+3. tear down the jax world (``hvd.shutdown`` → ``jax.distributed.shutdown``
+   → ``jax.extend.backend.clear_backends``) and re-initialize it against the
+   round's fresh coordinator, then ``hvd.init()``,
+4. record readiness in the KV for the driver's registry.
+
+Steps 3 is the piece the reference cannot do — XLA must forget the old
+backend before ``jax.distributed`` accepts a new world definition.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import time
+
+from ..utils import envs
+from ..utils import logging as hvd_logging
+from .driver import (
+    ROUND_KEY,
+    ROUND_SPEC_KEY,
+    SLOT_LOST_EXIT_CODE,
+    STOP_KEY,
+    done_key,
+    ready_key,
+)
+
+
+class WorkerRendezvous:
+    """Per-worker handle on the elastic round protocol."""
+
+    def __init__(self, kv_client=None):
+        if kv_client is None:
+            from ..runner.http_kv import KVClient
+            addr = envs.get(envs.KV_ADDR)
+            # HVD_ELASTIC discriminates elastic from static launches: static
+            # hvdrun also seeds HVD_KV_ADDR, but its launcher never publishes
+            # rounds — entering the elastic protocol there would stall
+            # instead of failing fast.
+            if not addr or not envs.get_bool(envs.ELASTIC):
+                raise RuntimeError(
+                    "not an elastic worker: HVD_ELASTIC/HVD_KV_ADDR not set "
+                    "(launch with `hvdrun --min-np/--max-np/"
+                    "--host-discovery-script`)")
+            kv_client = KVClient(addr, envs.get_int(envs.KV_PORT, 0),
+                                 secret=envs.get(envs.SECRET_KEY))
+        self.kv = kv_client
+        self.hostname = envs.get(envs.HOSTNAME) or "localhost"
+        # Stable worker identity: the local slot index assigned at spawn.
+        self.slot = envs.get_int(envs.LOCAL_RANK, 0)
+        self.round = int(os.environ.get("HVD_ELASTIC_ROUND", "1"))
+        self.timeout = envs.get_int(envs.ELASTIC_TIMEOUT, 600)
+
+    # -- protocol ----------------------------------------------------------
+
+    def record_ready(self) -> None:
+        self.kv.put(ready_key(self.round, self.hostname, self.slot), b"1")
+
+    def record_done(self) -> None:
+        """Mark this worker's training as complete — called before any jax
+        teardown so driver-side success cannot race a noisy process exit."""
+        self.kv.put(done_key(self.hostname, self.slot), b"1")
+
+    def reset(self) -> None:
+        """Re-rendezvous into the next round (the ``reset`` callback handed
+        to :func:`~horovod_tpu.elastic.state.run_fn`)."""
+        spec = self._wait_for_next_round()
+        my_slot = self._find_my_slot(spec)
+        if my_slot is None:
+            hvd_logging.info(
+                "slot %s[%d] not assigned in round %d; exiting",
+                self.hostname, self.slot, spec["round"])
+            sys.exit(SLOT_LOST_EXIT_CODE)
+        self._reinitialize(spec, my_slot)
+
+    def _wait_for_next_round(self) -> dict:
+        deadline = time.monotonic() + self.timeout
+        last_report = time.monotonic()
+        while True:
+            if self.kv.get(STOP_KEY) is not None:
+                hvd_logging.info("driver stopped the job during reset")
+                sys.exit(0)
+            raw = self.kv.get(ROUND_KEY)
+            if raw is not None:
+                round_id = int(raw.decode())
+                if round_id > self.round:
+                    spec_raw = self.kv.get(ROUND_SPEC_KEY.format(round_id))
+                    if spec_raw is not None:
+                        return pickle.loads(spec_raw)
+            now = time.monotonic()
+            if now - last_report > 5:
+                hvd_logging.info(
+                    "waiting for elastic round > %d (kv reports %s)",
+                    self.round, raw.decode() if raw else None)
+                last_report = now
+            if now > deadline:
+                raise TimeoutError(
+                    f"no new elastic round after {self.timeout}s "
+                    f"(stuck at round {self.round})")
+            time.sleep(0.25)
+
+    def _find_my_slot(self, spec: dict) -> dict | None:
+        for slot in spec["slots"]:
+            if (slot["hostname"] == self.hostname
+                    and slot["local_rank"] == self.slot):
+                return slot
+        return None
+
+    def _reinitialize(self, spec: dict, my_slot: dict) -> None:
+        import jax
+
+        from .. import runtime
+
+        hvd_logging.info(
+            "re-rendezvous into round %d: rank %d/%d via %s:%d",
+            spec["round"], my_slot["rank"], spec["world_size"],
+            spec["coord_addr"], spec["coord_port"])
+
+        runtime.shutdown()
+        jax.config.update("jax_enable_recoverability", True)
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:
+            # Graceful shutdown can fail when the round turned because a
+            # peer died. Abandon the old client/service objects so a fresh
+            # initialize can proceed; recoverability (set above) keeps the
+            # failure from being fatal.
+            hvd_logging.warning("jax.distributed shutdown failed (%s); "
+                                "abandoning old client", e)
+            from jax._src import distributed as _dist
+            _dist.global_state.preemption_sync_manager = None
+            _dist.global_state.client = None
+            _dist.global_state.service = None
+        # XLA must forget the old topology before a new world is defined.
+        from jax.extend import backend as jex_backend
+        jex_backend.clear_backends()
+        jax.clear_caches()
+
+        env = {
+            envs.RANK: my_slot["rank"],
+            envs.SIZE: spec["world_size"],
+            envs.LOCAL_RANK: my_slot["local_rank"],
+            envs.LOCAL_SIZE: my_slot["local_size"],
+            envs.CROSS_RANK: my_slot["cross_rank"],
+            envs.CROSS_SIZE: my_slot["cross_size"],
+            envs.PROCESS_ID: my_slot["rank"],
+            envs.NUM_PROCESSES: spec["world_size"],
+            envs.COORDINATOR_ADDR: spec["coord_addr"],
+            envs.COORDINATOR_PORT: spec["coord_port"],
+        }
+        for name, value in env.items():
+            os.environ["HVD_" + name] = str(value)
+
+        self.round = spec["round"]
+        runtime.init()
+        from .notification import notification_manager
+        notification_manager.mark_round_joined(self.round)
+        self.record_ready()
+
+
+_worker_rendezvous: WorkerRendezvous | None = None
+
+
+def get_worker_rendezvous() -> WorkerRendezvous:
+    global _worker_rendezvous
+    if _worker_rendezvous is None:
+        _worker_rendezvous = WorkerRendezvous()
+    return _worker_rendezvous

@@ -1,0 +1,219 @@
+"""Elastic worker state: save / restore / sync / commit.
+
+TPU-native rebuild of ``/root/reference/horovod/common/elastic.py`` (State,
+ObjectState, run_fn) plus a jax-pytree state class. The semantics are
+identical to the reference:
+
+- ``commit()`` saves state to host memory and checks for host-change
+  notifications, raising :class:`HostsUpdatedInterrupt` consistently across
+  ranks (the decision is broadcast from rank 0 so every rank interrupts at
+  the same step, reference ``elastic.py:74-98``).
+- ``run_fn`` wraps the user's training function in the recover loop:
+  ``HorovodInternalError`` → restore committed state, re-rendezvous, sync;
+  ``HostsUpdatedInterrupt`` → re-rendezvous, sync unless only additions
+  (reference ``elastic.py:151-174``).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import queue
+
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+
+class HostUpdateResult(enum.IntFlag):
+    """What changed in the host set (reference ``worker.py:38-42``)."""
+    no_update = 0
+    removed = 1
+    added = 2
+    mixed = removed | added
+
+
+class State:
+    """Base class tracking in-memory worker state across resets.
+
+    Args:
+      bcast_object: callable broadcasting a picklable object from rank 0.
+      get_rank: callable returning this worker's current rank.
+    """
+
+    def __init__(self, bcast_object, get_rank):
+        self._bcast_object = bcast_object
+        self._rank = get_rank
+        self._host_messages: queue.Queue = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks = []
+
+    def register_reset_callbacks(self, callbacks):
+        """Register callbacks invoked after every reset event — e.g. rescale
+        the learning rate to the new world size."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages = queue.Queue()
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        self._host_messages.put((timestamp, update_res))
+
+    def commit(self):
+        """Save state and raise :class:`HostsUpdatedInterrupt` if the host
+        set changed. Committing copies device arrays to host memory, so
+        committing less often than every batch trades throughput against
+        lost steps on failure (same trade-off as the reference)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise :class:`HostsUpdatedInterrupt` when a host-change
+        notification arrived; globally consistent via rank-0 broadcast."""
+        last_updated_timestamp = prev_timestamp = self._last_updated_timestamp
+        all_update = HostUpdateResult.no_update
+        while not self._host_messages.empty():
+            timestamp, update = self._host_messages.get()
+            if timestamp > last_updated_timestamp:
+                last_updated_timestamp = timestamp
+                all_update |= update
+
+        prev_timestamp, self._last_updated_timestamp, all_update = \
+            self._bcast_object(
+                (prev_timestamp, last_updated_timestamp, all_update))
+
+        if self._last_updated_timestamp > prev_timestamp:
+            # Removal-only: surviving workers already share identical state,
+            # so the post-reset sync can be skipped. Additions always sync —
+            # the new workers must receive rank 0's state (reference
+            # ``elastic.py:98``).
+            raise HostsUpdatedInterrupt(
+                skip_sync=(all_update == HostUpdateResult.removed))
+
+    def save(self):
+        """Save state to host memory."""
+        raise NotImplementedError()
+
+    def restore(self):
+        """Restore the last committed state, dropping uncommitted changes."""
+        raise NotImplementedError()
+
+    def sync(self):
+        """Synchronize state across workers (broadcast from rank 0)."""
+        raise NotImplementedError()
+
+    def reset(self):
+        """Hook run on reset before synchronization."""
+
+
+class ObjectState(State):
+    """State for plain picklable Python objects, exposed as attributes
+    (reference ``ObjectState``, ``elastic.py:113-148``)."""
+
+    def __init__(self, bcast_object, get_rank, **kwargs):
+        self._saved_state = kwargs
+        self._set_attrs()
+        super().__init__(bcast_object=bcast_object, get_rank=get_rank)
+
+    def save(self):
+        self._saved_state = {attr: getattr(self, attr)
+                             for attr in self._saved_state}
+
+    def restore(self):
+        self._set_attrs()
+
+    def sync(self):
+        if self._saved_state:
+            self._saved_state = self._bcast_object(self._saved_state)
+            self._set_attrs()
+
+    def _set_attrs(self):
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, value)
+
+
+class JaxState(ObjectState):
+    """Elastic state for jax pytrees (params / opt_state / batch counters).
+
+    The TPU analog of the reference's framework states
+    (``torch/elastic/state.py:27-160``, ``tensorflow/elastic.py``): pytree
+    attributes are committed by copying to host numpy (device arrays are
+    immutable but may live on chips that disappear), synced by broadcasting
+    rank 0's committed tree, and restored by re-uploading the host copy.
+
+    Usage::
+
+        state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                     epoch=0, batch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            ...
+            state.params = new_params
+            state.commit()
+    """
+
+    def __init__(self, **kwargs):
+        from .. import ops as hvd_ops
+        from .. import runtime as hvd_rt
+        import jax
+        import numpy as np
+
+        def to_host(tree):
+            return jax.tree_util.tree_map(np.asarray, tree)
+
+        self._to_host = to_host
+        host_kwargs = {
+            k: to_host(v) if self._is_pytree_of_arrays(v) else v
+            for k, v in kwargs.items()
+        }
+        super().__init__(
+            bcast_object=lambda obj: hvd_ops.broadcast_object(obj, root_rank=0),
+            get_rank=hvd_rt.rank,
+            **host_kwargs,
+        )
+
+    @staticmethod
+    def _is_pytree_of_arrays(value) -> bool:
+        import jax
+        leaves = jax.tree_util.tree_leaves(value)
+        return bool(leaves) and all(hasattr(leaf, "shape") for leaf in leaves)
+
+    def save(self):
+        self._saved_state = {
+            attr: self._to_host(getattr(self, attr))
+            if self._is_pytree_of_arrays(getattr(self, attr))
+            else getattr(self, attr)
+            for attr in self._saved_state
+        }
+
+
+def run_fn(func, reset):
+    """Wrap ``func(state, ...)`` in the elastic recover loop (reference
+    ``run_fn``, ``elastic.py:151-174``)."""
+    from .notification import notification_manager
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notification_manager.init()
+        notification_manager.register_listener(state)
+        skip_sync = False
+        try:
+            while True:
+                try:
+                    if not skip_sync:
+                        state.sync()
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    skip_sync = e.skip_sync
+
+                reset()
+                state.on_reset()
+        finally:
+            notification_manager.remove_listener(state)
+
+    return wrapper

@@ -1,0 +1,86 @@
+"""Framework exceptions.
+
+TPU-native rebuild of ``/root/reference/horovod/common/exceptions.py``: the
+two exception types that drive the elastic protocol (``run_fn`` catches both,
+``/root/reference/horovod/common/elastic.py:151-174``).
+"""
+
+from __future__ import annotations
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective routine fails mid-flight.
+
+    In elastic mode this triggers state restore + re-initialization instead
+    of aborting the job (reference semantics: NCCL async errors are turned
+    into this type via ``AsyncErrorCheck``, ``nccl_operations.cc:126-140``).
+    On TPU the analogous sources are ``jax.distributed`` runtime errors
+    (peer death, heartbeat loss, coordinator barrier failure); use
+    :func:`wrap_internal_errors` to translate them.
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Internal interrupt raised when the set of available hosts changed.
+
+    ``skip_sync`` is True when hosts were only *removed*: the surviving
+    workers still hold identical state, so the post-reset ``state.sync()``
+    can be skipped. Any addition forces a sync so the new workers receive
+    rank 0's state (reference raises with
+    ``all_update == HostUpdateResult.removed``).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+# Error-message fragments from the jax/XLA distributed runtime that indicate
+# a *membership/communication* failure (recoverable by re-initializing the
+# world) rather than a user bug.
+_TRANSIENT_DISTRIBUTED_MARKERS = (
+    "distributed",
+    "heartbeat",
+    "coordination service",
+    "preemption",
+    "deadline exceeded",
+    "unavailable",
+    "connection reset",
+    "connection closed",
+    "connection refused",
+    "socket closed",
+    "broken pipe",
+    "barrier",
+    # XLA CPU/TPU collective-runtime failures when a peer dies mid-op
+    "gloo",
+    "all-reduce failed",
+    "all-gather failed",
+    "collective",
+    "peer",
+)
+
+
+def is_recoverable_distributed_error(exc: BaseException) -> bool:
+    """Heuristic: does this exception look like a peer/communication failure
+    that elastic mode should recover from?"""
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(marker in text for marker in _TRANSIENT_DISTRIBUTED_MARKERS)
+
+
+def wrap_internal_errors(fn):
+    """Decorator translating recoverable jax distributed-runtime errors into
+    :class:`HorovodInternalError` so ``hvd.elastic.run`` can catch them."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except (HorovodInternalError, HostsUpdatedInterrupt):
+            raise
+        except Exception as e:
+            if is_recoverable_distributed_error(e):
+                raise HorovodInternalError(str(e)) from e
+            raise
+
+    return wrapper

@@ -8,8 +8,6 @@ from __future__ import annotations
 
 import sys
 
-import cloudpickle
-
 from . import hosts as hosts_mod
 from . import safe_exec
 from .hosts import HostSpec, SlotInfo, get_host_assignments, parse_hosts, parse_hostfile
@@ -32,6 +30,8 @@ def run(fn, args=(), kwargs=None, np: int = 1, *, hosts: str | None = None,
     """Run ``fn(*args, **kwargs)`` on ``np`` distributed workers and return
     the per-rank results, rank-ordered (reference ``horovod.run``,
     ``/root/reference/horovod/runner/__init__.py:93-214``)."""
+    import cloudpickle
+
     from .launch import JobRendezvous, _resolve_hosts, _supervise
 
     kwargs = kwargs or {}

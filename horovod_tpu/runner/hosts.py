@@ -97,6 +97,20 @@ def total_slots(hosts: list[HostSpec]) -> int:
     return sum(h.slots for h in hosts)
 
 
+def elastic_host_assignments(hosts: list[HostSpec], min_np: int,
+                             max_np: int | None) -> list[SlotInfo]:
+    """Elastic assignment (reference ``get_host_assignments(host_list,
+    min_np, max_np)``): use every available slot up to ``max_np``; raise when
+    fewer than ``min_np`` slots exist."""
+    capacity = total_slots(hosts)
+    if capacity < min_np:
+        raise ValueError(
+            f"only {capacity} slots available across {len(hosts)} hosts, "
+            f"fewer than the required minimum {min_np}")
+    np = capacity if max_np is None else min(capacity, max_np)
+    return get_host_assignments(hosts, np)
+
+
 def get_host_assignments(hosts: list[HostSpec], np: int) -> list[SlotInfo]:
     """Assign ``np`` ranks to hosts, host-major and contiguous (reference
     ``get_host_assignments``, ``hosts.py``): rank r lands on the first host

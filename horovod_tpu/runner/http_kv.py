@@ -90,8 +90,15 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         if not self._verify("PUT", payload):
             self._reject()
             return
+        key = self._key()
         with self.server.lock:  # type: ignore[attr-defined]
-            self.server.store[self._key()] = payload  # type: ignore[attr-defined]
+            self.server.store[key] = payload  # type: ignore[attr-defined]
+        observer = getattr(self.server, "on_put", None)
+        if observer is not None:
+            try:
+                observer(key, payload)
+            except Exception:  # observer bugs must not break the store
+                pass
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -121,8 +128,9 @@ class KVServer:
     ``RendezvousServer``). Start on an ephemeral port; share
     ``addr``/``port``/``secret`` with workers via env."""
 
-    def __init__(self, secret: str | None = None):
+    def __init__(self, secret: str | None = None, on_put=None):
         self.secret = secret
+        self.on_put = on_put  # callback(key, payload) for driver observers
         self._httpd: _ThreadedHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -131,6 +139,7 @@ class KVServer:
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.secret = self.secret  # type: ignore[attr-defined]
+        self._httpd.on_put = self.on_put  # type: ignore[attr-defined]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="hvd-kv-server")
         self._thread.start()

@@ -61,6 +61,11 @@ def parse_args(argv=None):
                         help="elastic: maximum world size")
     parser.add_argument("--host-discovery-script", default=None,
                         help="elastic: executable printing one host:slots per line")
+    parser.add_argument("--reset-limit", type=int, default=None,
+                        help="elastic: stop after this many resets")
+    parser.add_argument("--blacklist-cooldown-range", nargs=2, type=float,
+                        default=None, metavar=("LO", "HI"),
+                        help="elastic: blacklisted-host cooldown bounds (s)")
     parser.add_argument("--ssh-port", type=int, default=None)
     parser.add_argument("--ssh-identity-file", default=None)
     parser.add_argument("--start-timeout", type=float, default=600.0,
@@ -121,14 +126,16 @@ def _explicit_dests(argv, parser) -> set:
             if action is None:
                 break  # unknown flag: the training command has started
             explicit.add(action.dest)
-            consumes_value = ("=" not in tok
-                              and not isinstance(action, (
-                                  argparse._StoreTrueAction,
-                                  argparse._StoreFalseAction,
-                                  argparse._CountAction,
-                                  argparse._HelpAction,
-                                  argparse._VersionAction)))
-            i += 2 if consumes_value else 1
+            if "=" in tok or isinstance(action, (
+                    argparse._StoreTrueAction, argparse._StoreFalseAction,
+                    argparse._CountAction, argparse._HelpAction,
+                    argparse._VersionAction)):
+                consumed = 0
+            elif isinstance(action.nargs, int):
+                consumed = action.nargs  # e.g. --blacklist-cooldown-range LO HI
+            else:
+                consumed = 1
+            i += 1 + consumed
             continue
         break  # first positional token: the training command has started
     return explicit

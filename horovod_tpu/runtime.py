@@ -149,6 +149,13 @@ def _maybe_distributed_init() -> None:
         return
     port = envs.get(envs.COORDINATOR_PORT, "9778")
     proc_id = envs.get_int(envs.PROCESS_ID, 0)
+    if envs.get_bool(envs.ELASTIC):
+        # A peer crash must not fatally poison the coordination service:
+        # recoverability keeps the shutdown barrier and error polling from
+        # terminating surviving workers, so hvd.elastic can rebuild the
+        # world instead (the analog of the reference's elastic
+        # AsyncErrorCheck path, ``nccl_operations.cc:126-140``).
+        jax.config.update("jax_enable_recoverability", True)
     try:
         jax.distributed.initialize(
             coordinator_address=f"{addr}:{port}",

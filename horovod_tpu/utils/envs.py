@@ -49,6 +49,11 @@ COORDINATOR_ADDR = "COORDINATOR_ADDR"
 COORDINATOR_PORT = "COORDINATOR_PORT"
 NUM_PROCESSES = "NUM_PROCESSES"
 PROCESS_ID = "PROCESS_ID"
+KV_ADDR = "KV_ADDR"
+KV_PORT = "KV_PORT"
+SECRET_KEY = "SECRET_KEY"
+HOSTNAME = "HOSTNAME"
+ELASTIC = "ELASTIC"  # "1" in workers launched by an elastic driver
 
 _PREFIXES = ("HVD_", "HOROVOD_")
 

@@ -62,3 +62,7 @@ def warning(msg: str, *args) -> None:
 
 def error(msg: str, *args) -> None:
     get_logger().error(msg, *args)
+
+
+def exception(msg: str, *args) -> None:
+    get_logger().exception(msg, *args)

@@ -1,0 +1,437 @@
+"""Elastic subsystem unit tests: state commit/restore/sync semantics,
+discovery + blacklist, registry decisions, and the driver's round protocol
+with mocked workers — "multi-node without a cluster" exactly like the
+reference's ``test/single/test_elastic_driver.py`` (FixedHosts / scripted
+discovery, no real processes)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import (
+    ElasticDriver,
+    ElasticRendezvous,
+    FixedHosts,
+    HostDiscoveryScript,
+    HostManager,
+    HostUpdateResult,
+    HostsUpdatedInterrupt,
+    JaxState,
+    ObjectState,
+    WorkerStateRegistry,
+    run_fn,
+)
+from horovod_tpu.exceptions import HorovodInternalError
+from horovod_tpu.runner.http_kv import KVServer
+
+
+def _identity_bcast(obj):
+    return obj
+
+
+# --- State / ObjectState --------------------------------------------------
+
+class TestObjectState:
+    def test_save_restore(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0, batch=5)
+        state.epoch = 3
+        state.batch = 7
+        state.restore()
+        assert state.epoch == 0 and state.batch == 5
+        state.epoch = 3
+        state.save()
+        state.epoch = 9
+        state.restore()
+        assert state.epoch == 3
+
+    def test_commit_added_requires_sync(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        state.on_hosts_updated(time.time(), HostUpdateResult.added)
+        with pytest.raises(HostsUpdatedInterrupt) as exc:
+            state.commit()
+        assert not exc.value.skip_sync  # new workers must receive state
+
+    def test_commit_removed_skips_sync(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        state.on_hosts_updated(time.time(), HostUpdateResult.removed)
+        with pytest.raises(HostsUpdatedInterrupt) as exc:
+            state.commit()
+        assert exc.value.skip_sync  # survivors already consistent
+
+    def test_commit_no_update_passes(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        state.commit()  # no notification: no interrupt
+
+    def test_reset_callbacks(self):
+        called = []
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        state.register_reset_callbacks([lambda: called.append(1)])
+        state.on_reset()
+        assert called == [1]
+
+
+class TestJaxState:
+    def test_pytree_commit_restore(self):
+        import jax.numpy as jnp
+        import numpy as np
+        params = {"w": jnp.ones((2, 2)), "b": jnp.zeros(2)}
+        state = JaxState(params=params, epoch=0)
+        state.params = {"w": jnp.full((2, 2), 5.0), "b": jnp.ones(2)}
+        state.restore()
+        np.testing.assert_allclose(np.asarray(state.params["w"]),
+                                   np.ones((2, 2)))
+        state.params = {"w": jnp.full((2, 2), 5.0), "b": jnp.ones(2)}
+        state.save()
+        state.params = {"w": jnp.zeros((2, 2)), "b": jnp.zeros(2)}
+        state.restore()
+        np.testing.assert_allclose(np.asarray(state.params["w"]),
+                                   np.full((2, 2), 5.0))
+
+    def test_sync_broadcasts(self):
+        import numpy as np
+        state = JaxState(params={"w": np.ones(3)}, epoch=4)
+        state.sync()  # single-controller world: broadcast is identity
+        assert state.epoch == 4
+
+
+# --- run_fn recover loop --------------------------------------------------
+
+class TestRunFn:
+    def test_returns_result(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        wrapped = run_fn(lambda s: "done", reset=lambda: None)
+        assert wrapped(state) == "done"
+
+    def test_internal_error_restores_and_resets(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        resets = []
+        calls = []
+
+        def train(s):
+            calls.append(1)
+            if len(calls) == 1:
+                s.epoch = 99  # uncommitted: must be rolled back
+                raise HorovodInternalError("peer died")
+            assert s.epoch == 0
+            return "recovered"
+
+        wrapped = run_fn(train, reset=lambda: resets.append(1))
+        assert wrapped(state) == "recovered"
+        assert resets == [1]
+
+    def test_hosts_updated_syncs_and_resets(self):
+        state = ObjectState(_identity_bcast, lambda: 0, epoch=0)
+        seq = []
+
+        def train(s):
+            if not seq:
+                seq.append("first")
+                raise HostsUpdatedInterrupt(skip_sync=False)
+            return "resumed"
+
+        wrapped = run_fn(train, reset=lambda: seq.append("reset"))
+        assert wrapped(state) == "resumed"
+        assert seq == ["first", "reset"]
+
+
+# --- discovery ------------------------------------------------------------
+
+class TestHostManager:
+    def test_added_and_removed(self):
+        disc = FixedHosts({"a": 2})
+        mgr = HostManager(disc)
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        assert mgr.current_hosts.count_available_slots() == 2
+
+        disc.set({"a": 2, "b": 2})
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        assert mgr.current_hosts.host_assignment_order == ["a", "b"]
+
+        disc.set({"b": 2})
+        assert mgr.update_available_hosts() == HostUpdateResult.removed
+        assert mgr.current_hosts.host_assignment_order == ["b"]
+
+    def test_slot_growth_is_added(self):
+        disc = FixedHosts({"a": 1})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        disc.set({"a": 4})
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+
+    def test_no_change(self):
+        disc = FixedHosts({"a": 2})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        assert mgr.update_available_hosts() == HostUpdateResult.no_update
+
+    def test_blacklist_excludes_host(self):
+        disc = FixedHosts({"a": 2, "b": 2})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        mgr.blacklist("a")
+        assert mgr.is_blacklisted("a")
+        assert mgr.current_hosts.host_assignment_order == ["b"]
+        assert mgr.current_hosts.count_available_slots() == 2
+
+    def test_order_preserves_oldest_first(self):
+        order = HostManager.order_available_hosts({"c", "a", "b"}, ["b", "c"])
+        assert order == ["b", "c", "a"]
+
+    def test_cooldown_resurrection(self):
+        disc = FixedHosts({"a": 2})
+        mgr = HostManager(disc, cooldown_range=(1, 2))
+        mgr.update_available_hosts()
+        mgr.blacklist("a")
+        assert mgr.current_hosts.count_available_slots() == 0
+        time.sleep(2.5)  # cooldown (1s lower bound, doubling + jitter) ends
+        res = mgr.update_available_hosts()
+        assert res & HostUpdateResult.added
+        assert not mgr.is_blacklisted("a")
+        assert mgr.current_hosts.count_available_slots() == 2
+
+
+class TestHostDiscoveryScript:
+    def test_parses_output(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho host-1:4\necho host-2\n")
+        script.chmod(0o755)
+        disc = HostDiscoveryScript(str(script), default_slots=2)
+        assert disc.find_available_hosts_and_slots() == \
+            {"host-1": 4, "host-2": 2}
+
+    def test_failure_raises(self, tmp_path):
+        script = tmp_path / "bad.sh"
+        script.write_text("#!/bin/sh\nexit 3\n")
+        script.chmod(0o755)
+        with pytest.raises(RuntimeError):
+            HostDiscoveryScript(str(script)).find_available_hosts_and_slots()
+
+
+# --- driver with mocked workers ------------------------------------------
+
+class FakeProc:
+    """Worker-process stand-in whose exit is scripted by the test."""
+
+    def __init__(self):
+        self._exit = threading.Event()
+        self._code = None
+        self.terminated = False
+
+    def exit(self, code):
+        self._code = code
+        self._exit.set()
+
+    def wait(self, timeout=None):
+        self._exit.wait(timeout)
+        return self._code
+
+    def poll(self):
+        return self._code if self._exit.is_set() else None
+
+    def terminate(self):
+        self.terminated = True
+        if not self._exit.is_set():
+            self.exit(143)
+
+
+class DriverHarness:
+    def __init__(self, host_slots, min_np, max_np=None, **kw):
+        self.kv = KVServer()
+        self.kv.start()
+        self.discovery = FixedHosts(host_slots)
+        self.rendezvous = ElasticRendezvous(self.kv)
+        self.driver = ElasticDriver(self.rendezvous, self.discovery,
+                                    min_np, max_np, timeout=10, **kw)
+        self.procs = {}  # (host, slot) -> list of FakeProc (per spawn)
+        self.lock = threading.Lock()
+
+    def create_worker(self, slot_info, spec_round):
+        proc = FakeProc()
+        with self.lock:
+            self.procs.setdefault(
+                (slot_info.hostname, slot_info.local_rank), []).append(proc)
+        return proc
+
+    def start(self, np):
+        self.driver.start(np, self.create_worker)
+
+    def wait_for_workers(self, n, timeout=5):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                count = sum(len(v) for v in self.procs.values())
+            if count >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"expected {n} spawned workers, got {count}")
+
+    def stop(self):
+        self.driver.stop()
+        self.kv.stop()
+
+
+class TestElasticDriver:
+    def test_initial_spawn(self):
+        h = DriverHarness({"a": 2, "b": 2}, min_np=2, max_np=4)
+        try:
+            h.start(2)
+            h.wait_for_workers(4)  # elastic uses all slots up to max_np
+            assert h.driver.world_size() == 4
+            assert h.driver.has_rank_assignment("a", 0)
+            assert h.driver.get_slot_info("a", 0).rank == 0
+            spec_round = h.rendezvous.round_id
+            assert spec_round == 1
+            assert h.kv.get("elastic/round") == b"1"
+        finally:
+            h.stop()
+
+    def test_worker_success_stops_job(self):
+        h = DriverHarness({"a": 1}, min_np=1)
+        try:
+            h.start(1)
+            h.wait_for_workers(1)
+            h.procs[("a", 0)][0].exit(0)
+            deadline = time.monotonic() + 5
+            while not h.driver.finished() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert h.driver.finished()
+            results = h.driver.get_results()
+            assert results.worker_results["a[0]"][0] == 0
+        finally:
+            h.stop()
+
+    def test_worker_failure_blacklists_and_resizes(self):
+        h = DriverHarness({"a": 1, "b": 1}, min_np=1, max_np=2)
+        try:
+            h.start(2)
+            h.wait_for_workers(2)
+            h.procs[("b", 0)][0].exit(1)  # b dies
+            deadline = time.monotonic() + 5
+            while h.rendezvous.round_id < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            # host b blacklisted; new round published with only host a
+            # (the registry clears per-round states when the round turns)
+            assert h.rendezvous.round_id >= 2
+            assert h.driver.world_size() == 1
+            assert not h.driver.has_rank_assignment("b", 0)
+            assert not h.driver.finished()
+        finally:
+            h.stop()
+
+    def test_all_failures_stop_job(self):
+        h = DriverHarness({"a": 1}, min_np=1)
+        try:
+            h.start(1)
+            h.wait_for_workers(1)
+            h.procs[("a", 0)][0].exit(1)
+            deadline = time.monotonic() + 5
+            while not h.driver.finished() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert h.driver.finished()
+        finally:
+            h.stop()
+
+    def test_host_added_triggers_new_round(self):
+        h = DriverHarness({"a": 1}, min_np=1, max_np=4)
+        try:
+            h.start(1)
+            h.wait_for_workers(1)
+            h.discovery.set({"a": 1, "b": 1})
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                if h.rendezvous.round_id >= 2 and ("b", 0) in h.procs:
+                    break
+                time.sleep(0.05)
+            assert h.rendezvous.round_id >= 2
+            assert ("b", 0) in h.procs  # new worker spawned on b
+            assert h.driver.world_size() == 2
+            # notify key written for existing workers
+            assert h.kv.get("elastic/notify") is not None
+        finally:
+            h.stop()
+
+    def test_slot_lost_exit_is_ignored(self):
+        h = DriverHarness({"a": 1, "b": 1}, min_np=1, max_np=2)
+        try:
+            h.start(2)
+            h.wait_for_workers(2)
+            h.discovery.set({"a": 1})  # b removed by discovery
+            deadline = time.monotonic() + 8
+            while h.rendezvous.round_id < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            from horovod_tpu.elastic.driver import SLOT_LOST_EXIT_CODE
+            h.procs[("b", 0)][0].exit(SLOT_LOST_EXIT_CODE)
+            time.sleep(0.3)
+            assert not h.driver.finished()
+            assert h.driver.registry.count("FAILURE") == 0
+        finally:
+            h.stop()
+
+    def test_reset_limit_stops_job(self):
+        h = DriverHarness({"a": 1, "b": 1, "c": 1}, min_np=1, max_np=3,
+                          reset_limit=1)
+        try:
+            h.start(3)
+            h.wait_for_workers(3)
+            h.procs[("c", 0)][0].exit(1)  # reset 1: allowed
+            deadline = time.monotonic() + 5
+            while h.rendezvous.round_id < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            h.procs[("b", 0)][0].exit(1)  # reset 2: over the limit
+            deadline = time.monotonic() + 5
+            while not h.driver.finished() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert h.driver.finished()
+            assert "reset limit" in (h.driver.get_results().error_message or "")
+        finally:
+            h.stop()
+
+
+class TestWorkerStateRegistry:
+    class _StubDriver:
+        def __init__(self):
+            self.stopped = False
+            self.resumed = 0
+
+        def finished(self):
+            return self.stopped
+
+        def stop(self, error_message=None, success=False):
+            self.stopped = True
+            self.error = error_message
+            self.success = success
+
+        def resume(self):
+            self.resumed += 1
+
+    def test_ready_records(self):
+        drv = self._StubDriver()
+        mgr = HostManager(FixedHosts({"a": 2}))
+        reg = WorkerStateRegistry(drv, mgr)
+        reg.reset(2)
+        reg.record_ready("a", 0)
+        reg.record_ready("a", 1)
+        assert reg.count("READY") == 2
+        assert not drv.stopped
+
+    def test_success_stops(self):
+        drv = self._StubDriver()
+        reg = WorkerStateRegistry(drv, HostManager(FixedHosts({"a": 1})))
+        reg.reset(1)
+        reg.record_success("a", 0)
+        assert drv.stopped
+
+    def test_failure_blacklists_and_resumes(self):
+        drv = self._StubDriver()
+        mgr = HostManager(FixedHosts({"a": 1, "b": 1}))
+        mgr.update_available_hosts()
+        reg = WorkerStateRegistry(drv, mgr)
+        reg.reset(2)
+        reg.record_ready("a", 0)
+        reg.record_failure("b", 0)
+        assert mgr.is_blacklisted("b")
+        assert drv.resumed == 1
+        assert not drv.stopped
