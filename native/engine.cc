@@ -1,0 +1,713 @@
+/* The dynamic engine: request queue, readiness negotiation, response cache,
+ * fusion planning, and stall detection.
+ *
+ * TPU-native rebuild of the reference's core runtime components:
+ *   - TensorQueue            (tensor_queue.cc, duplicate-name detection at
+ *                             common.h:229-232)
+ *   - Controller bookkeeping (controller.cc:73-430 ComputeResponseList,
+ *                             IncrementTensorCount readiness table,
+ *                             ConstructResponse shape/dtype mismatch ERRORs,
+ *                             FuseResponses fusion packing)
+ *   - ResponseCache          (response_cache.cc LRU + bitvector
+ *                             coordination, response_cache.h:50,107-169)
+ *   - GroupTable             (group_table.cc, enforced joint fusion at
+ *                             controller.cc:213-237)
+ *   - StallInspector         (stall_inspector.cc, warn/shutdown thresholds
+ *                             at stall_inspector.h:71-86)
+ *
+ * Execution is NOT here: XLA runs the collectives. Every rank feeds the
+ * identical rank-ordered request lists into ingest() and deterministically
+ * computes the same fused response plan — the symmetric degeneration of the
+ * reference's rank-0 master protocol (controller.h:72-108) natural on a TPU
+ * mesh where the transport is an allgather.
+ */
+
+#include "hvd_core.h"
+
+#include <algorithm>
+#include <chrono>
+#include <list>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+#include "timeline.h"
+#include "wire.h"
+
+namespace hvd {
+namespace {
+
+double now_seconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string shape_to_string(const std::vector<int64_t>& shape) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (i) os << ", ";
+    os << shape[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+/* ---------------------------------------------------------------- cache */
+
+struct TensorParams {
+  int32_t dtype = 0;
+  int32_t root_rank = -1;
+  uint8_t type = 0;
+  std::vector<int64_t> shape;
+
+  bool operator==(const TensorParams& o) const {
+    return dtype == o.dtype && root_rank == o.root_rank && type == o.type &&
+           shape == o.shape;
+  }
+};
+
+/* LRU cache of prior responses (response_cache.h). A HIT lets ranks skip
+ * full negotiation for tensors whose metadata is unchanged — coordinated
+ * via a bitvector AND across ranks. */
+class ResponseCache {
+ public:
+  enum class State { MISS, HIT, INVALID };
+
+  void set_capacity(uint32_t cap) {
+    capacity_ = cap;
+    while (lru_.size() > capacity_) evict_lru();
+  }
+  uint32_t capacity() const { return capacity_; }
+  size_t size() const { return lru_.size(); }
+
+  State cached(const Request& q) const {
+    auto it = index_.find(q.name);
+    if (it == index_.end()) return State::MISS;
+    const Entry& e = *it->second;
+    TensorParams p{q.dtype, q.root_rank, static_cast<uint8_t>(q.type),
+                   q.shape};
+    return e.params == p ? State::HIT : State::INVALID;
+  }
+
+  void put(const Request& q, const Response& resp) {
+    if (capacity_ == 0) return;
+    auto it = index_.find(q.name);
+    if (it != index_.end()) {
+      lru_.erase(it->second);
+      index_.erase(it);
+    }
+    while (lru_.size() >= capacity_) evict_lru();
+    lru_.push_front(Entry{
+        q.name,
+        TensorParams{q.dtype, q.root_rank, static_cast<uint8_t>(q.type),
+                     q.shape},
+        resp});
+    index_[q.name] = lru_.begin();
+    bits_dirty_ = true;
+  }
+
+  void erase(const std::string& name) {
+    auto it = index_.find(name);
+    if (it == index_.end()) return;
+    lru_.erase(it->second);
+    index_.erase(it);
+    bits_dirty_ = true;
+  }
+
+  /* Touch as most-recently-used. */
+  void touch(const std::string& name) {
+    auto it = index_.find(name);
+    if (it == index_.end()) return;
+    lru_.splice(lru_.begin(), lru_, it->second);
+    bits_dirty_ = true;
+  }
+
+  /* Stable bit position per cached name for the coordination bitvector
+   * (update_cache_bits, response_cache.cc). Recomputed lazily: position =
+   * LRU order at computation time; identical on every rank because every
+   * rank applies identical put/erase/touch sequences. */
+  int32_t bit_of(const std::string& name) {
+    refresh_bits();
+    auto it = bit_index_.find(name);
+    return it == bit_index_.end() ? -1 : it->second;
+  }
+
+  const Response* response_at_bit(int32_t bit) {
+    refresh_bits();
+    if (bit < 0 || bit >= static_cast<int32_t>(bit_names_.size()))
+      return nullptr;
+    auto it = index_.find(bit_names_[bit]);
+    return it == index_.end() ? nullptr : &it->second->response;
+  }
+
+  size_t num_bits() {
+    refresh_bits();
+    return bit_names_.size();
+  }
+
+ private:
+  struct Entry {
+    std::string name;
+    TensorParams params;
+    Response response;
+  };
+
+  void evict_lru() {
+    if (lru_.empty()) return;
+    index_.erase(lru_.back().name);
+    lru_.pop_back();
+    bits_dirty_ = true;
+  }
+
+  void refresh_bits() {
+    if (!bits_dirty_) return;
+    bit_index_.clear();
+    bit_names_.clear();
+    int32_t i = 0;
+    for (const auto& e : lru_) {
+      bit_index_[e.name] = i++;
+      bit_names_.push_back(e.name);
+    }
+    bits_dirty_ = false;
+  }
+
+  uint32_t capacity_ = 1024;
+  std::list<Entry> lru_;
+  std::unordered_map<std::string, std::list<Entry>::iterator> index_;
+  std::unordered_map<std::string, int32_t> bit_index_;
+  std::vector<std::string> bit_names_;
+  bool bits_dirty_ = true;
+};
+
+/* ------------------------------------------------------------ the engine */
+
+class Engine {
+ public:
+  Engine(int32_t world_size, int32_t rank, int64_t fusion_threshold,
+         int32_t cache_capacity, double stall_warn, double stall_shutdown)
+      : world_size_(world_size),
+        rank_(rank),
+        fusion_threshold_(fusion_threshold),
+        stall_warn_(stall_warn),
+        stall_shutdown_(stall_shutdown) {
+    cache_.set_capacity(static_cast<uint32_t>(cache_capacity));
+  }
+
+  int32_t enqueue(const char* name, int32_t request_type, int32_t dtype,
+                  int32_t element_size, const int64_t* shape, int32_t ndim,
+                  int32_t root_rank, int32_t group_id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    std::string key(name);
+    if (outstanding_.count(key)) return -1;  // duplicate name still in flight
+    Request q;
+    q.rank = rank_;
+    q.type = static_cast<RequestType>(request_type);
+    q.dtype = dtype;
+    q.element_size = element_size;
+    q.root_rank = root_rank;
+    q.group_id = group_id;
+    q.name = std::move(key);
+    q.shape.assign(shape, shape + ndim);
+    outstanding_.insert(q.name);
+    pending_.push_back(std::move(q));
+    return 0;
+  }
+
+  int32_t pop_requests(const uint8_t** out, size_t* out_len) {
+    std::lock_guard<std::mutex> lock(mu_);
+    RequestList list;
+    list.requests = std::move(pending_);
+    pending_.clear();
+    // Track locally submitted requests awaiting a response plan; cache
+    // lookups and the stall inspector key off this set.
+    for (auto& q : list.requests) {
+      local_inflight_[q.name] = q;
+    }
+    Writer w;
+    list.serialize(w);
+    pop_buf_ = std::move(w.buf);
+    *out = pop_buf_.data();
+    *out_len = pop_buf_.size();
+    return 0;
+  }
+
+  int32_t ingest(int32_t rank, const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> lock(mu_);
+    Reader r(data, len);
+    RequestList list;
+    try {
+      list = RequestList::parse(r);
+    } catch (const std::exception&) {
+      return -1;
+    }
+    double now = now_seconds();
+    for (auto& q : list.requests) {
+      if (q.type == RequestType::JOIN) {
+        joined_ranks_.insert(rank);
+        join_pending_ = true;
+        continue;
+      }
+      auto it = table_.find(q.name);
+      if (it == table_.end()) {
+        TableEntry e;
+        e.first = q;
+        e.first_rank = rank;
+        e.ready_ranks.insert(rank);
+        e.first_seen = now;
+        e.sequence = next_sequence_++;
+        table_.emplace(q.name, std::move(e));
+      } else {
+        TableEntry& e = it->second;
+        validate(e, q, rank);
+        e.ready_ranks.insert(rank);
+      }
+    }
+    return 0;
+  }
+
+  int32_t cache_bits(const uint8_t** out, size_t* out_len) {
+    std::lock_guard<std::mutex> lock(mu_);
+    size_t nbits = cache_.num_bits();
+    bits_buf_.assign((nbits + 7) / 8, 0);
+    for (const auto& kv : local_inflight_) {
+      const Request& q = kv.second;
+      if (q.type == RequestType::BARRIER || q.type == RequestType::JOIN)
+        continue;  // never cached (controller.cc:100-104)
+      if (cache_.cached(q) == ResponseCache::State::HIT) {
+        int32_t bit = cache_.bit_of(q.name);
+        if (bit >= 0) bits_buf_[bit / 8] |= (1u << (bit % 8));
+      }
+    }
+    *out = bits_buf_.data();
+    *out_len = bits_buf_.size();
+    return 0;
+  }
+
+  int32_t commit_cache_bits(const uint8_t* bits, size_t len) {
+    std::lock_guard<std::mutex> lock(mu_);
+    cache_hits_this_cycle_.clear();
+    std::vector<std::string> served;
+    for (auto& kv : local_inflight_) {
+      const Request& q = kv.second;
+      auto state = cache_.cached(q);
+      if (state == ResponseCache::State::INVALID) {
+        cache_.erase(q.name);
+        continue;
+      }
+      if (state != ResponseCache::State::HIT) continue;
+      int32_t bit = cache_.bit_of(q.name);
+      bool global_hit = bit >= 0 &&
+                        static_cast<size_t>(bit / 8) < len &&
+                        (bits[bit / 8] >> (bit % 8)) & 1;
+      if (global_hit) {
+        const Response* resp = cache_.response_at_bit(bit);
+        if (resp != nullptr) {
+          Response r = *resp;
+          r.from_cache = true;
+          cache_hits_this_cycle_.push_back(std::move(r));
+          served.push_back(q.name);
+        }
+      }
+    }
+    for (const auto& name : served) {
+      cache_.touch(name);
+      complete(name);
+    }
+    return 0;
+  }
+
+  int32_t compute_responses(const uint8_t** out, size_t* out_len) {
+    std::lock_guard<std::mutex> lock(mu_);
+    ResponseList result;
+
+    // cache-served responses first (fast path)
+    for (auto& r : cache_hits_this_cycle_) result.responses.push_back(std::move(r));
+    cache_hits_this_cycle_.clear();
+
+    // collect table entries that are ready on every (non-joined) rank
+    std::vector<const TableEntry*> ready;
+    std::vector<Response> errors;
+    for (auto& kv : table_) {
+      TableEntry& e = kv.second;
+      if (!e.error_message.empty()) {
+        if (all_ranks_in(e)) {
+          Response err;
+          err.type = ResponseType::ERROR;
+          err.error_message = e.error_message;
+          err.tensor_names = {e.first.name};
+          errors.push_back(std::move(err));
+          e.done = true;
+        }
+        continue;
+      }
+      if (all_ranks_in(e)) ready.push_back(&e);
+    }
+    std::sort(ready.begin(), ready.end(),
+              [](const TableEntry* a, const TableEntry* b) {
+                return a->sequence < b->sequence;
+              });
+
+    // group-table constraint: a grouped tensor may only be scheduled when
+    // its whole group is ready (controller.cc:213-237)
+    std::map<int32_t, std::vector<const TableEntry*>> groups;
+    for (const TableEntry* e : ready) {
+      if (e->first.group_id >= 0) groups[e->first.group_id].push_back(e);
+    }
+
+    std::vector<const TableEntry*> schedulable;
+    for (const TableEntry* e : ready) {
+      int32_t g = e->first.group_id;
+      if (g < 0) {
+        schedulable.push_back(e);
+        continue;
+      }
+      size_t expected = group_member_counts_.count(g)
+                            ? group_member_counts_[g]
+                            : groups[g].size();
+      if (groups[g].size() >= expected) schedulable.push_back(e);
+    }
+
+    fuse(schedulable, result);
+    for (auto& err : errors) result.responses.push_back(std::move(err));
+
+    // JOIN: emitted only when every rank joined (controller.cc:268-272)
+    if (join_pending_ &&
+        joined_ranks_.size() == static_cast<size_t>(world_size_)) {
+      Response j;
+      j.type = ResponseType::JOIN;
+      result.responses.push_back(std::move(j));
+      joined_ranks_.clear();
+      join_pending_ = false;
+    }
+
+    // mark scheduled tensors complete + populate the cache
+    for (const TableEntry* e : schedulable) {
+      if (e->first.type != RequestType::BARRIER) {
+        Response proto;
+        proto.type = static_cast<ResponseType>(e->first.type);
+        proto.dtype = e->first.dtype;
+        proto.root_rank = e->first.root_rank;
+        proto.total_bytes = e->first.byte_size();
+        proto.tensor_names = {e->first.name};
+        cache_.put(e->first, proto);
+      }
+    }
+    std::vector<std::string> done_names;
+    for (const TableEntry* e : schedulable) done_names.push_back(e->first.name);
+    for (auto& kv : table_) {
+      if (kv.second.done) done_names.push_back(kv.first);
+    }
+    for (const auto& n : done_names) {
+      table_.erase(n);
+      complete(n);
+    }
+
+    Writer w;
+    result.serialize(w);
+    resp_buf_ = std::move(w.buf);
+    *out = resp_buf_.data();
+    *out_len = resp_buf_.size();
+    return 0;
+  }
+
+  int32_t stall_report(const uint8_t** out, size_t* out_len) {
+    std::lock_guard<std::mutex> lock(mu_);
+    double now = now_seconds();
+    Writer w;
+    uint32_t count = 0;
+    Writer body;
+    bool shutdown = false;
+    for (const auto& kv : table_) {
+      const TableEntry& e = kv.second;
+      double waited = now - e.first_seen;
+      if (!e.ready_ranks.empty() && !all_ranks_in(e) && waited > stall_warn_) {
+        body.str(kv.first);
+        body.u32(static_cast<uint32_t>(e.ready_ranks.size()));
+        for (int32_t r : e.ready_ranks) body.u32(static_cast<uint32_t>(r));
+        body.f64(waited);
+        ++count;
+        if (stall_shutdown_ > 0 && waited > stall_shutdown_) shutdown = true;
+      }
+    }
+    w.u32(count);
+    w.buf.insert(w.buf.end(), body.buf.begin(), body.buf.end());
+    stall_buf_ = std::move(w.buf);
+    *out = stall_buf_.data();
+    *out_len = stall_buf_.size();
+    return shutdown ? 1 : 0;
+  }
+
+  void register_group(int32_t group_id, size_t n_members) {
+    std::lock_guard<std::mutex> lock(mu_);
+    group_member_counts_[group_id] = n_members;
+  }
+
+  int32_t pending_count() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return static_cast<int32_t>(pending_.size() + local_inflight_.size());
+  }
+  int32_t cache_size() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return static_cast<int32_t>(cache_.size());
+  }
+
+  Timeline timeline;
+
+ private:
+  struct TableEntry {
+    Request first;
+    int32_t first_rank = 0;
+    std::set<int32_t> ready_ranks;
+    double first_seen = 0;
+    uint64_t sequence = 0;
+    bool done = false;
+    std::string error_message;
+  };
+
+  bool all_ranks_in(const TableEntry& e) const {
+    // joined ranks count as implicitly ready for every tensor
+    size_t effective = e.ready_ranks.size();
+    for (int32_t r : joined_ranks_)
+      if (!e.ready_ranks.count(r)) ++effective;
+    return effective >= static_cast<size_t>(world_size_);
+  }
+
+  /* Mismatch checks mirroring ConstructResponse (controller.cc): two ranks
+   * submitting the same name with different type/dtype/shape is a user
+   * error answered with an informative ERROR response, not an abort. */
+  void validate(TableEntry& e, const Request& q, int32_t rank) {
+    if (!e.error_message.empty()) return;
+    std::ostringstream os;
+    if (q.type != e.first.type) {
+      os << "Mismatched collective operations: rank " << e.first_rank
+         << " performed " << request_type_name(e.first.type) << " on tensor "
+         << e.first.name << " while rank " << rank << " performed "
+         << request_type_name(q.type) << ".";
+      e.error_message = os.str();
+      return;
+    }
+    if (q.dtype != e.first.dtype) {
+      os << "Mismatched data types: rank " << e.first_rank
+         << " submitted tensor " << e.first.name << " with dtype id "
+         << e.first.dtype << " while rank " << rank << " submitted dtype id "
+         << q.dtype << ".";
+      e.error_message = os.str();
+      return;
+    }
+    bool shape_must_match = q.type == RequestType::ALLREDUCE ||
+                            q.type == RequestType::ADASUM ||
+                            q.type == RequestType::BROADCAST ||
+                            q.type == RequestType::REDUCESCATTER;
+    bool dims_after_first_must_match = q.type == RequestType::ALLGATHER ||
+                                       q.type == RequestType::ALLTOALL;
+    if (shape_must_match && q.shape != e.first.shape) {
+      os << "Mismatched " << request_type_name(q.type) << " tensor shapes: "
+         << "rank " << e.first_rank << " submitted " << e.first.name
+         << " with shape " << shape_to_string(e.first.shape) << " while rank "
+         << rank << " submitted shape " << shape_to_string(q.shape) << ".";
+      e.error_message = os.str();
+      return;
+    }
+    if (dims_after_first_must_match) {
+      bool ok = q.shape.size() == e.first.shape.size();
+      for (size_t i = 1; ok && i < q.shape.size(); ++i)
+        ok = q.shape[i] == e.first.shape[i];
+      if (!ok) {
+        os << "Mismatched " << request_type_name(q.type)
+           << " tensor shapes: all dimensions except the first must match "
+           << "(rank " << e.first_rank << ": "
+           << shape_to_string(e.first.shape) << ", rank " << rank << ": "
+           << shape_to_string(q.shape) << ") for tensor " << e.first.name
+           << ".";
+        e.error_message = os.str();
+        return;
+      }
+    }
+    if (q.type == RequestType::BROADCAST && q.root_rank != e.first.root_rank) {
+      os << "Mismatched broadcast root ranks: rank " << e.first_rank
+         << " used root " << e.first.root_rank << " while rank " << rank
+         << " used root " << q.root_rank << " for tensor " << e.first.name
+         << ".";
+      e.error_message = os.str();
+    }
+  }
+
+  /* FuseResponses (controller.cc): pack consecutive ready responses of the
+   * same fusable class under the fusion threshold into joint responses. */
+  void fuse(const std::vector<const TableEntry*>& schedulable,
+            ResponseList& result) {
+    Response current;
+    bool open = false;
+    auto flush = [&]() {
+      if (open) {
+        result.responses.push_back(current);
+        open = false;
+      }
+    };
+    for (const TableEntry* e : schedulable) {
+      const Request& q = e->first;
+      ResponseType rtype = static_cast<ResponseType>(q.type);
+      bool fusable = q.type == RequestType::ALLREDUCE ||
+                     q.type == RequestType::ADASUM ||
+                     q.type == RequestType::ALLGATHER ||
+                     q.type == RequestType::BROADCAST;
+      int64_t bytes = q.byte_size();
+      if (!fusable) {
+        flush();
+        Response r;
+        r.type = rtype;
+        r.dtype = q.dtype;
+        r.root_rank = q.root_rank;
+        r.total_bytes = bytes;
+        r.tensor_names = {q.name};
+        result.responses.push_back(std::move(r));
+        continue;
+      }
+      bool joinable = open && current.type == rtype &&
+                      current.dtype == q.dtype &&
+                      current.root_rank == q.root_rank &&
+                      current.total_bytes + bytes <= fusion_threshold_;
+      if (joinable) {
+        current.tensor_names.push_back(q.name);
+        current.total_bytes += bytes;
+      } else {
+        flush();
+        current = Response();
+        current.type = rtype;
+        current.dtype = q.dtype;
+        current.root_rank = q.root_rank;
+        current.total_bytes = bytes;
+        current.tensor_names = {q.name};
+        open = true;
+      }
+    }
+    flush();
+  }
+
+  void complete(const std::string& name) {
+    local_inflight_.erase(name);
+    outstanding_.erase(name);
+  }
+
+  int32_t world_size_;
+  int32_t rank_;
+  int64_t fusion_threshold_;
+  double stall_warn_;
+  double stall_shutdown_;
+
+  std::mutex mu_;
+  std::vector<Request> pending_;
+  std::set<std::string> outstanding_;
+  std::unordered_map<std::string, Request> local_inflight_;
+  std::map<std::string, TableEntry> table_;
+  std::set<int32_t> joined_ranks_;
+  bool join_pending_ = false;
+  uint64_t next_sequence_ = 0;
+  std::map<int32_t, size_t> group_member_counts_;
+
+  ResponseCache cache_;
+  std::vector<Response> cache_hits_this_cycle_;
+
+  std::vector<uint8_t> pop_buf_, resp_buf_, bits_buf_, stall_buf_;
+};
+
+}  // namespace
+}  // namespace hvd
+
+/* ------------------------------------------------------------- C API --- */
+
+extern "C" {
+
+hvd_engine_t hvd_engine_create(int32_t world_size, int32_t rank,
+                               int64_t fusion_threshold_bytes,
+                               int32_t cache_capacity,
+                               double stall_warn_seconds,
+                               double stall_shutdown_seconds) {
+  return new hvd::Engine(world_size, rank, fusion_threshold_bytes,
+                         cache_capacity, stall_warn_seconds,
+                         stall_shutdown_seconds);
+}
+
+void hvd_engine_destroy(hvd_engine_t engine) {
+  delete static_cast<hvd::Engine*>(engine);
+}
+
+int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
+                           int32_t request_type, int32_t dtype,
+                           int32_t element_size, const int64_t* shape,
+                           int32_t ndim, int32_t root_rank, int32_t group_id) {
+  return static_cast<hvd::Engine*>(engine)->enqueue(
+      name, request_type, dtype, element_size, shape, ndim, root_rank,
+      group_id);
+}
+
+int32_t hvd_engine_pop_requests(hvd_engine_t engine, const uint8_t** out,
+                                size_t* out_len) {
+  return static_cast<hvd::Engine*>(engine)->pop_requests(out, out_len);
+}
+
+int32_t hvd_engine_ingest(hvd_engine_t engine, int32_t rank,
+                          const uint8_t* data, size_t len) {
+  return static_cast<hvd::Engine*>(engine)->ingest(rank, data, len);
+}
+
+int32_t hvd_engine_compute_responses(hvd_engine_t engine, const uint8_t** out,
+                                     size_t* out_len) {
+  return static_cast<hvd::Engine*>(engine)->compute_responses(out, out_len);
+}
+
+int32_t hvd_engine_cache_bits(hvd_engine_t engine, const uint8_t** out,
+                              size_t* out_len) {
+  return static_cast<hvd::Engine*>(engine)->cache_bits(out, out_len);
+}
+
+int32_t hvd_engine_commit_cache_bits(hvd_engine_t engine, const uint8_t* bits,
+                                     size_t len) {
+  return static_cast<hvd::Engine*>(engine)->commit_cache_bits(bits, len);
+}
+
+int32_t hvd_engine_stall_report(hvd_engine_t engine, const uint8_t** out,
+                                size_t* out_len) {
+  return static_cast<hvd::Engine*>(engine)->stall_report(out, out_len);
+}
+
+void hvd_engine_register_group(hvd_engine_t engine, int32_t group_id,
+                               int32_t n_members) {
+  static_cast<hvd::Engine*>(engine)->register_group(
+      group_id, static_cast<size_t>(n_members));
+}
+
+int32_t hvd_timeline_start(hvd_engine_t engine, const char* path) {
+  return static_cast<hvd::Engine*>(engine)->timeline.start(path);
+}
+
+void hvd_timeline_stop(hvd_engine_t engine) {
+  static_cast<hvd::Engine*>(engine)->timeline.stop();
+}
+
+void hvd_timeline_record(hvd_engine_t engine, const char* tensor,
+                         const char* activity, int32_t phase,
+                         int64_t timestamp_us) {
+  static_cast<hvd::Engine*>(engine)->timeline.record(tensor, activity, phase,
+                                                     timestamp_us);
+}
+
+int32_t hvd_engine_pending_count(hvd_engine_t engine) {
+  return static_cast<hvd::Engine*>(engine)->pending_count();
+}
+
+int32_t hvd_engine_cache_size(hvd_engine_t engine) {
+  return static_cast<hvd::Engine*>(engine)->cache_size();
+}
+
+const char* hvd_core_version(void) { return "hvd_core 0.1.0"; }
+
+}  /* extern "C" */

@@ -1,0 +1,143 @@
+/* hvd_core: C API of the horovod_tpu native dynamic engine.
+ *
+ * TPU-native rebuild of the reference's C++ core runtime
+ * (/root/reference/horovod/common/: operations.cc, controller.cc,
+ * tensor_queue.cc, response_cache.cc, fusion_buffer_manager.cc,
+ * group_table.cc, stall_inspector.cc, timeline.cc). The split of labor is
+ * inverted for TPU (SURVEY.md §7): XLA executes the collectives, so this
+ * engine owns everything *around* execution — request queueing, readiness
+ * negotiation bookkeeping, response caching, fusion planning, stall
+ * detection, and timeline tracing — and hands fused execution plans back to
+ * the Python/jax layer.
+ *
+ * The negotiation is symmetric rather than master-worker: every rank
+ * ingests the identical, rank-ordered set of serialized request lists and
+ * deterministically computes the same response plan (the coordinator
+ * protocol of controller.h:72-108 degenerates to this when the transport is
+ * an allgather, which is the natural collective on a TPU mesh).
+ *
+ * All buffers returned through out-parameters are owned by the engine's
+ * last call on that slot and remain valid until the next call on the same
+ * engine from the same thread; copy out before re-entering.
+ */
+
+#ifndef HVD_CORE_H
+#define HVD_CORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* hvd_engine_t;
+
+/* Request/response types, mirroring message.h:52-54,155-157 */
+enum {
+  HVD_REQ_ALLREDUCE = 0,
+  HVD_REQ_ALLGATHER = 1,
+  HVD_REQ_BROADCAST = 2,
+  HVD_REQ_JOIN = 3,
+  HVD_REQ_ADASUM = 4,
+  HVD_REQ_ALLTOALL = 5,
+  HVD_REQ_BARRIER = 6,
+  HVD_REQ_REDUCESCATTER = 7
+};
+
+enum {
+  HVD_RESP_ALLREDUCE = 0,
+  HVD_RESP_ALLGATHER = 1,
+  HVD_RESP_BROADCAST = 2,
+  HVD_RESP_JOIN = 3,
+  HVD_RESP_ADASUM = 4,
+  HVD_RESP_ALLTOALL = 5,
+  HVD_RESP_BARRIER = 6,
+  HVD_RESP_REDUCESCATTER = 7,
+  HVD_RESP_ERROR = 8
+};
+
+/* engine lifecycle ------------------------------------------------------- */
+
+hvd_engine_t hvd_engine_create(int32_t world_size, int32_t rank,
+                               int64_t fusion_threshold_bytes,
+                               int32_t cache_capacity,
+                               double stall_warn_seconds,
+                               double stall_shutdown_seconds);
+void hvd_engine_destroy(hvd_engine_t engine);
+
+/* worker side ------------------------------------------------------------ */
+
+/* Enqueue a named tensor request (EnqueueTensorAllreduce et al.,
+ * operations.cc:1357-1795). dtype is an opaque small int chosen by the
+ * caller (only equality matters for mismatch checks / fusion classes);
+ * element_size is bytes per element for fusion accounting. root_rank is
+ * used by BROADCAST, group_id groups tensors for joint fusion (-1 = none).
+ * Returns 0, or -1 on duplicate name still pending (common.h:229-232). */
+int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
+                           int32_t request_type, int32_t dtype,
+                           int32_t element_size, const int64_t* shape,
+                           int32_t ndim, int32_t root_rank, int32_t group_id);
+
+/* Serialize and clear this rank's pending requests (the per-cycle
+ * PopMessagesFromQueue, controller.cc:92). */
+int32_t hvd_engine_pop_requests(hvd_engine_t engine, const uint8_t** out,
+                                size_t* out_len);
+
+/* negotiation (symmetric) ------------------------------------------------ */
+
+/* Ingest one rank's serialized request list for this cycle. Must be called
+ * for every rank (including self) in rank order on every member. */
+int32_t hvd_engine_ingest(hvd_engine_t engine, int32_t rank,
+                          const uint8_t* data, size_t len);
+
+/* Compute the fused response plan for every tensor now ready on all ranks
+ * (ComputeResponseList + FuseResponses, controller.cc:73-430). The result
+ * is a serialized ResponseList; identical on every rank by construction.
+ * Also advances stall bookkeeping. */
+int32_t hvd_engine_compute_responses(hvd_engine_t engine, const uint8_t** out,
+                                     size_t* out_len);
+
+/* response cache --------------------------------------------------------- */
+
+/* Bit vector (little-endian bytes) of cache entries this rank could serve
+ * from cache for its *pending* requests; AND-reduce across ranks and pass
+ * to hvd_engine_commit_cache_bits (CoordinateCacheAndState,
+ * response_cache.h:107-169). */
+int32_t hvd_engine_cache_bits(hvd_engine_t engine, const uint8_t** out,
+                              size_t* out_len);
+
+/* Commit the globally ANDed bit vector: pending requests whose cache bit
+ * survived are moved into the response plan without full negotiation. */
+int32_t hvd_engine_commit_cache_bits(hvd_engine_t engine, const uint8_t* bits,
+                                     size_t len);
+
+/* stall inspector -------------------------------------------------------- */
+
+/* Returns a serialized report of tensors submitted by some-but-not-all
+ * ranks for longer than stall_warn_seconds (stall_inspector.h:75-86):
+ * u32 count, then per entry: str name, u32 n_ready, u32 ready_ranks[],
+ * f64 waiting_seconds. Returns 1 if the shutdown threshold was crossed. */
+int32_t hvd_engine_stall_report(hvd_engine_t engine, const uint8_t** out,
+                                size_t* out_len);
+
+/* timeline --------------------------------------------------------------- */
+
+int32_t hvd_timeline_start(hvd_engine_t engine, const char* path);
+void hvd_timeline_stop(hvd_engine_t engine);
+/* phase: 0 = begin, 1 = end, 2 = instant */
+void hvd_timeline_record(hvd_engine_t engine, const char* tensor,
+                         const char* activity, int32_t phase,
+                         int64_t timestamp_us);
+
+/* introspection ---------------------------------------------------------- */
+
+int32_t hvd_engine_pending_count(hvd_engine_t engine);
+int32_t hvd_engine_cache_size(hvd_engine_t engine);
+const char* hvd_core_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* HVD_CORE_H */

@@ -1,0 +1,164 @@
+/* Request/Response message types for the dynamic engine.
+ *
+ * TPU-native rebuild of the reference's message layer
+ * (/root/reference/horovod/common/message.h:52-157 — Request{ALLREDUCE,
+ * ALLGATHER, BROADCAST, JOIN, ADASUM, ALLTOALL, BARRIER}, Response{...,
+ * ERROR}, RequestList/ResponseList) with a hand-rolled wire format
+ * (see wire.h) instead of FlatBuffers.
+ */
+
+#ifndef HVD_MESSAGE_H
+#define HVD_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "wire.h"
+
+namespace hvd {
+
+enum class RequestType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  JOIN = 3,
+  ADASUM = 4,
+  ALLTOALL = 5,
+  BARRIER = 6,
+  REDUCESCATTER = 7,
+};
+
+enum class ResponseType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  JOIN = 3,
+  ADASUM = 4,
+  ALLTOALL = 5,
+  BARRIER = 6,
+  REDUCESCATTER = 7,
+  ERROR = 8,
+};
+
+inline const char* request_type_name(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return "ALLREDUCE";
+    case RequestType::ALLGATHER: return "ALLGATHER";
+    case RequestType::BROADCAST: return "BROADCAST";
+    case RequestType::JOIN: return "JOIN";
+    case RequestType::ADASUM: return "ADASUM";
+    case RequestType::ALLTOALL: return "ALLTOALL";
+    case RequestType::BARRIER: return "BARRIER";
+    case RequestType::REDUCESCATTER: return "REDUCESCATTER";
+  }
+  return "?";
+}
+
+struct Request {
+  int32_t rank = 0;
+  RequestType type = RequestType::ALLREDUCE;
+  int32_t dtype = 0;
+  int32_t element_size = 0;
+  int32_t root_rank = -1;
+  int32_t group_id = -1;
+  std::string name;
+  std::vector<int64_t> shape;
+
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+  int64_t byte_size() const { return num_elements() * element_size; }
+
+  void serialize(Writer& w) const {
+    w.i32(rank);
+    w.u8(static_cast<uint8_t>(type));
+    w.i32(dtype);
+    w.i32(element_size);
+    w.i32(root_rank);
+    w.i32(group_id);
+    w.str(name);
+    w.u32(static_cast<uint32_t>(shape.size()));
+    for (int64_t d : shape) w.i64(d);
+  }
+
+  static Request parse(Reader& r) {
+    Request q;
+    q.rank = r.i32();
+    q.type = static_cast<RequestType>(r.u8());
+    q.dtype = r.i32();
+    q.element_size = r.i32();
+    q.root_rank = r.i32();
+    q.group_id = r.i32();
+    q.name = r.str();
+    uint32_t nd = r.u32();
+    q.shape.resize(nd);
+    for (uint32_t i = 0; i < nd; ++i) q.shape[i] = r.i64();
+    return q;
+  }
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+
+  void serialize(Writer& w) const {
+    w.u32(static_cast<uint32_t>(requests.size()));
+    for (const auto& q : requests) q.serialize(w);
+  }
+  static RequestList parse(Reader& r) {
+    RequestList l;
+    uint32_t n = r.u32();
+    l.requests.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) l.requests.push_back(Request::parse(r));
+    return l;
+  }
+};
+
+struct Response {
+  ResponseType type = ResponseType::ALLREDUCE;
+  int32_t dtype = 0;
+  int32_t root_rank = -1;
+  int64_t total_bytes = 0;   // fused payload size (fusion accounting)
+  bool from_cache = false;
+  std::string error_message;
+  std::vector<std::string> tensor_names;
+
+  void serialize(Writer& w) const {
+    w.u8(static_cast<uint8_t>(type));
+    w.i32(dtype);
+    w.i32(root_rank);
+    w.i64(total_bytes);
+    w.u8(from_cache ? 1 : 0);
+    w.str(error_message);
+    w.u32(static_cast<uint32_t>(tensor_names.size()));
+    for (const auto& n : tensor_names) w.str(n);
+  }
+  static Response parse(Reader& r) {
+    Response s;
+    s.type = static_cast<ResponseType>(r.u8());
+    s.dtype = r.i32();
+    s.root_rank = r.i32();
+    s.total_bytes = r.i64();
+    s.from_cache = r.u8() != 0;
+    s.error_message = r.str();
+    uint32_t n = r.u32();
+    s.tensor_names.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) s.tensor_names.push_back(r.str());
+    return s;
+  }
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+
+  void serialize(Writer& w) const {
+    w.u32(static_cast<uint32_t>(responses.size()));
+    for (const auto& s : responses) s.serialize(w);
+  }
+};
+
+}  // namespace hvd
+
+#endif  // HVD_MESSAGE_H

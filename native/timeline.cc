@@ -1,0 +1,115 @@
+#include "timeline.h"
+
+#include <chrono>
+
+namespace hvd {
+namespace {
+
+int64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+int32_t Timeline::start(const std::string& path) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (active_) return 0;
+  out_.open(path, std::ios::out | std::ios::trunc);
+  if (!out_.is_open()) return -1;
+  out_ << "[\n";
+  first_event_ = true;
+  shutdown_ = false;
+  active_ = true;
+  writer_ = std::thread([this] { writer_loop(); });
+  return 0;
+}
+
+void Timeline::stop() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (!active_) return;
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  std::lock_guard<std::mutex> lock(mu_);
+  out_ << "\n]\n";
+  out_.close();
+  active_ = false;
+}
+
+void Timeline::record(const std::string& tensor, const std::string& activity,
+                      int32_t phase, int64_t timestamp_us) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!active_ || queue_.size() >= kMaxQueue) return;  // drop when full
+  queue_.push_back(Record{tensor, activity, phase,
+                          timestamp_us >= 0 ? timestamp_us : now_us()});
+  cv_.notify_one();
+}
+
+void Timeline::writer_loop() {
+  std::unique_lock<std::mutex> lock(mu_);
+  for (;;) {
+    cv_.wait(lock, [this] { return !queue_.empty() || shutdown_; });
+    while (!queue_.empty()) {
+      Record r = std::move(queue_.front());
+      queue_.pop_front();
+      write_record(r);
+    }
+    if (shutdown_) return;
+  }
+}
+
+void Timeline::write_record(const Record& r) {
+  // Called with mu_ held (writer thread only).
+  const char* ph = r.phase == 0 ? "B" : (r.phase == 1 ? "E" : "i");
+  if (!first_event_) out_ << ",\n";
+  first_event_ = false;
+  out_ << "{\"name\": \"" << json_escape(r.activity) << "\", \"cat\": \""
+       << json_escape(r.tensor) << "\", \"ph\": \"" << ph
+       << "\", \"ts\": " << r.ts_us << ", \"pid\": 0, \"tid\": "
+       << lane_of(r.tensor);
+  if (r.phase == 2) out_ << ", \"s\": \"t\"";
+  out_ << "}";
+}
+
+int64_t Timeline::lane_of(const std::string& tensor) {
+  auto it = lanes_.find(tensor);
+  if (it != lanes_.end()) return it->second;
+  int64_t lane = next_lane_++;
+  lanes_.emplace(tensor, lane);
+  // name the lane after the tensor so the trace viewer shows one row per
+  // tensor, like the reference's per-tensor timeline rows
+  if (!first_event_) out_ << ",\n";
+  first_event_ = false;
+  out_ << "{\"name\": \"thread_name\", \"ph\": \"M\", \"pid\": 0, \"tid\": "
+       << lane << ", \"args\": {\"name\": \"" << json_escape(tensor)
+       << "\"}}";
+  return lane;
+}
+
+}  // namespace hvd

@@ -1,0 +1,71 @@
+/* Chrome-trace timeline writer.
+ *
+ * TPU-native rebuild of the reference Timeline
+ * (/root/reference/horovod/common/timeline.h:48-100, timeline.cc): a
+ * dedicated writer thread consumes a bounded queue of records and emits
+ * Chrome trace-event JSON (catapult "Trace Event Format"). The reference
+ * uses a 1M-entry boost lock-free SPSC queue; a mutex + condvar deque is
+ * equivalent here (producers are a handful of Python threads, the bound
+ * guards memory the same way).
+ */
+
+#ifndef HVD_TIMELINE_H
+#define HVD_TIMELINE_H
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  ~Timeline() { stop(); }
+
+  /* Open `path` and start the writer thread. Returns 0, -1 on IO error. */
+  int32_t start(const std::string& path);
+
+  /* Flush and close. Idempotent. */
+  void stop();
+
+  bool active() const { return active_; }
+
+  /* phase: 0 begin ("B"), 1 end ("E"), 2 instant ("i").
+   * timestamp_us < 0 means "stamp with the engine's own clock". */
+  void record(const std::string& tensor, const std::string& activity,
+              int32_t phase, int64_t timestamp_us);
+
+ private:
+  struct Record {
+    std::string tensor;
+    std::string activity;
+    int32_t phase;
+    int64_t ts_us;
+  };
+
+  static constexpr size_t kMaxQueue = 1 << 20;  // reference: 1M records
+
+  void writer_loop();
+  void write_record(const Record& r);
+  int64_t lane_of(const std::string& tensor);
+
+  std::ofstream out_;
+  bool active_ = false;
+  bool first_event_ = true;
+  std::thread writer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Record> queue_;
+  bool shutdown_ = false;
+  std::unordered_map<std::string, int64_t> lanes_;
+  int64_t next_lane_ = 1;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TIMELINE_H

@@ -1,0 +1,93 @@
+/* Little-endian binary serialization helpers for the engine wire format.
+ *
+ * The reference serializes Request/Response lists with FlatBuffers
+ * (wire/message.fbs); this rebuild uses a hand-rolled fixed little-endian
+ * layout instead — the payloads are tiny (tensor names + shapes), both ends
+ * are this library, and zero third-party dependencies keeps the build to a
+ * single g++ invocation.
+ */
+
+#ifndef HVD_WIRE_H
+#define HVD_WIRE_H
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v) {
+    for (int i = 0; i < 4; ++i) buf.push_back((v >> (8 * i)) & 0xff);
+  }
+  void i32(int32_t v) { u32(static_cast<uint32_t>(v)); }
+  void u64(uint64_t v) {
+    for (int i = 0; i < 8; ++i) buf.push_back((v >> (8 * i)) & 0xff);
+  }
+  void i64(int64_t v) { u64(static_cast<uint64_t>(v)); }
+  void f64(double v) {
+    uint64_t bits;
+    std::memcpy(&bits, &v, 8);
+    u64(bits);
+  }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    buf.insert(buf.end(), s.begin(), s.end());
+  }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : data_(data), len_(len), pos_(0) {}
+
+  uint8_t u8() {
+    need(1);
+    return data_[pos_++];
+  }
+  uint32_t u32() {
+    need(4);
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= static_cast<uint32_t>(data_[pos_++]) << (8 * i);
+    return v;
+  }
+  int32_t i32() { return static_cast<int32_t>(u32()); }
+  uint64_t u64() {
+    need(8);
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= static_cast<uint64_t>(data_[pos_++]) << (8 * i);
+    return v;
+  }
+  int64_t i64() { return static_cast<int64_t>(u64()); }
+  double f64() {
+    uint64_t bits = u64();
+    double v;
+    std::memcpy(&v, &bits, 8);
+    return v;
+  }
+  std::string str() {
+    uint32_t n = u32();
+    need(n);
+    std::string s(reinterpret_cast<const char*>(data_ + pos_), n);
+    pos_ += n;
+    return s;
+  }
+  bool done() const { return pos_ >= len_; }
+
+ private:
+  void need(size_t n) {
+    if (pos_ + n > len_) throw std::runtime_error("wire: truncated buffer");
+  }
+  const uint8_t* data_;
+  size_t len_;
+  size_t pos_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_WIRE_H
