@@ -158,8 +158,12 @@ class ElasticDriver:
     def __init__(self, rendezvous: ElasticRendezvous, discovery,
                  min_np: int, max_np: int | None = None,
                  timeout: float | None = None, reset_limit: int | None = None,
-                 cooldown_range=None, verbose: int = 0):
+                 cooldown_range=None, verbose: int = 0,
+                 remote_port_probe=None):
         self._rendezvous = rendezvous
+        # Optional callable(host) -> free port on that host (over ssh);
+        # falls back to a random pick when absent or failing.
+        self._remote_port_probe = remote_port_probe
         self._host_manager = HostManager(discovery, cooldown_range)
         self._min_np = min_np
         self._max_np = max_np
@@ -180,6 +184,10 @@ class ElasticDriver:
         self._active_procs: dict[tuple[str, int], object] = {}
         self._proc_lock = threading.Lock()
         self._success = False
+
+        # Host updates that arrived while a round transition held
+        # _round_lock; only touched by the discovery thread.
+        self._deferred_update = HostUpdateResult.no_update
 
         self._worker_registry = WorkerStateRegistry(
             self, self._host_manager, reset_limit=reset_limit)
@@ -350,9 +358,10 @@ class ElasticDriver:
                     update_res = HostUpdateResult.no_update
                 if update_res != HostUpdateResult.no_update:
                     self._wait_hosts_cond.notify_all()
-            if (update_res != HostUpdateResult.no_update and not first_update
+            pending = update_res | self._deferred_update
+            if (pending != HostUpdateResult.no_update and not first_update
                     and self._create_worker_fn is not None):
-                self._on_hosts_updated(update_res)
+                self._on_hosts_updated(pending)
             first_update = False
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_S)
 
@@ -363,12 +372,22 @@ class ElasticDriver:
         the job loudly rather than silently killing the thread (a dead
         discovery thread would freeze elasticity for the rest of the run).
         """
+        # The assignment comparison must run under the round lock: a
+        # concurrent registry-driven resume() may be publishing a round for
+        # this very host change, and comparing against stale assignments
+        # would publish a redundant duplicate round. But the acquire must
+        # not block: a resume() parked in wait_for_available_slots (slots <
+        # min_np) holds the lock while *depending on this thread* to keep
+        # discovering replacement hosts — blocking here would deadlock the
+        # scale-down-then-replace scenario. Defer instead and retry on the
+        # next discovery tick.
+        if not self._round_lock.acquire(blocking=False):
+            self._deferred_update |= update_res
+            return
+        stop_error = None
         try:
-            # The assignment comparison must run under the round lock: a
-            # concurrent registry-driven resume() may be publishing a round
-            # for this very host change, and comparing against stale
-            # assignments would publish a redundant duplicate round.
-            with self._round_lock:
+            self._deferred_update = HostUpdateResult.no_update
+            try:
                 current_hosts = self._host_manager.current_hosts
                 if current_hosts.count_available_slots() < self._min_np:
                     hvd_logging.warning(
@@ -389,9 +408,15 @@ class ElasticDriver:
                         "host change does not alter assignments")
                     return
                 self._activate_workers(self._min_np)
-        except Exception as e:
-            hvd_logging.exception("failed to apply host update")
-            self.stop(error_message=f"host update failed: {e}")
+            except Exception as e:
+                hvd_logging.exception("failed to apply host update")
+                stop_error = f"host update failed: {e}"
+        finally:
+            self._round_lock.release()
+        if stop_error is not None:
+            # stop() tears down worker processes (seconds of grace time per
+            # proc) — never do that while holding the round lock.
+            self.stop(error_message=stop_error)
 
     def _compute_assignments(self, current_hosts):
         host_list = [hosts_mod.HostSpec(h, current_hosts.get_slots(h))
@@ -446,9 +471,17 @@ class ElasticDriver:
                 is_local_host(h) for h in self._host_assignments) else \
                 local_addresses()[0]
             return addr, _free_port()
-        # Remote coordinator: the driver cannot probe free ports there, so
-        # pick a random high port; collisions surface as rendezvous errors
-        # and trigger the next round.
+        # Remote coordinator: ask that host's kernel for a free ephemeral
+        # port over ssh; a blind random pick risks a collision that fails
+        # the rank-0 worker and blacklists the very host holding committed
+        # state. Random fallback only if the probe itself fails.
+        if self._remote_port_probe is not None:
+            try:
+                return coord_host, int(self._remote_port_probe(coord_host))
+            except Exception as e:
+                hvd_logging.warning(
+                    "free-port probe on %s failed (%s); falling back to a "
+                    "random port", coord_host, e)
         return coord_host, random.randint(29500, 64000)
 
     def _active_slots(self):

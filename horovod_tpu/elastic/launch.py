@@ -74,7 +74,9 @@ def run_elastic(args, command: list[str]) -> int:
         cooldown_range=(tuple(args.blacklist_cooldown_range)
                         if getattr(args, "blacklist_cooldown_range", None)
                         else None),
-        verbose=1 if args.verbose else 0)
+        verbose=1 if args.verbose else 0,
+        remote_port_probe=lambda host: launch_mod.probe_remote_free_port(
+            host, args.ssh_port, args.ssh_identity_file))
     driver_holder.append(driver)
 
     extra_base = dict(args._config_env)

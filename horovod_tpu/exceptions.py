@@ -37,7 +37,10 @@ class HostsUpdatedInterrupt(RuntimeError):
 
 # Error-message fragments from the jax/XLA distributed runtime that indicate
 # a *membership/communication* failure (recoverable by re-initializing the
-# world) rather than a user bug.
+# world) rather than a user bug. Applied only to exception types raised by
+# the jax/jaxlib/grpc runtime itself — a user's HTTP 503 ("service
+# unavailable") must surface as the real traceback, not be swallowed into
+# an elastic retry loop.
 _TRANSIENT_DISTRIBUTED_MARKERS = (
     "distributed",
     "heartbeat",
@@ -59,12 +62,48 @@ _TRANSIENT_DISTRIBUTED_MARKERS = (
     "peer",
 )
 
+# For exceptions of builtin type (e.g. the ValueError XLA raises when a
+# gloo collective loses a peer, or a RuntimeError from jax.distributed) the
+# type's module tells us nothing, so only multi-word phrases specific to
+# the coordination/collective runtime qualify — single words like
+# "unavailable" or "peer" would swallow ordinary user errors.
+_STRICT_DISTRIBUTED_MARKERS = (
+    "coordination service",
+    "deadline_exceeded",
+    "jax.distributed",
+    "distributed runtime",
+    "preemption sync",
+    "connection closed by peer",
+    "connection reset by peer",
+    "all-reduce failed",
+    "all-gather failed",
+    "all-to-all failed",
+    "collective-permute failed",
+    "gloo broadcast failed",
+    "gloo reduce failed",
+    "gloo barrier failed",
+)
+
+
+def _is_runtime_module(mod: str) -> bool:
+    # Exactly jax/jaxlib and their submodules — NOT jaxtyping/jaxopt (user
+    # libraries) and NOT grpc (user grpc-python errors say "unavailable"
+    # for ordinary service outages; jax's own runtime raises jaxlib types).
+    return (mod in ("jax", "jaxlib")
+            or mod.startswith(("jax.", "jaxlib.", "jax._src")))
+
 
 def is_recoverable_distributed_error(exc: BaseException) -> bool:
-    """Heuristic: does this exception look like a peer/communication failure
-    that elastic mode should recover from?"""
+    """Does this exception look like a peer/communication failure that
+    elastic mode should recover from? Matches broad markers only on
+    exception types owned by the jax/jaxlib runtime (e.g.
+    ``jaxlib...XlaRuntimeError``); builtin-typed exceptions must carry a
+    multi-word phrase specific to the coordination/collective runtime."""
     text = f"{type(exc).__name__}: {exc}".lower()
-    return any(marker in text for marker in _TRANSIENT_DISTRIBUTED_MARKERS)
+    mod = type(exc).__module__ or ""
+    if _is_runtime_module(mod):
+        return any(m in text for m in _TRANSIENT_DISTRIBUTED_MARKERS)
+    return any(m in text for m in _STRICT_DISTRIBUTED_MARKERS)
 
 
 def wrap_internal_errors(fn):

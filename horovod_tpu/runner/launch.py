@@ -20,6 +20,7 @@ import functools
 import os
 import shlex
 import socket
+import subprocess
 import sys
 import threading
 
@@ -232,6 +233,12 @@ def worker_env(slot: hosts_mod.SlotInfo, *, coordinator_addr: str,
 _SECRET_ENV_VARS = ("HVD_SECRET_KEY",)
 
 
+def _ssh_base_cmd(ssh_port: int | None, identity_file: str | None) -> list[str]:
+    return (["ssh"] + SSH_OPTIONS
+            + (["-p", str(ssh_port)] if ssh_port else [])
+            + (["-i", identity_file] if identity_file else []))
+
+
 def _ssh_command(hostname: str, command: list[str], env: dict[str, str],
                  ssh_port: int | None, identity_file: str | None) -> list[str]:
     public_env = {k: v for k, v in env.items() if k not in _SECRET_ENV_VARS}
@@ -241,13 +248,7 @@ def _ssh_command(hostname: str, command: list[str], env: dict[str, str],
                             for k in _SECRET_ENV_VARS if k in env)
     remote = (f"cd {shlex.quote(os.getcwd())} 2>/dev/null; {secret_reads} "
               f"{exports} " + " ".join(shlex.quote(c) for c in command))
-    cmd = ["ssh"] + SSH_OPTIONS
-    if ssh_port:
-        cmd += ["-p", str(ssh_port)]
-    if identity_file:
-        cmd += ["-i", identity_file]
-    cmd += [hostname, remote]
-    return cmd
+    return _ssh_base_cmd(ssh_port, identity_file) + [hostname, remote]
 
 
 def spawn_worker(slot: hosts_mod.SlotInfo, command: list[str],
@@ -274,6 +275,25 @@ def spawn_worker(slot: hosts_mod.SlotInfo, command: list[str],
                              stdin_data=secret_lines or None, owned_files=owned)
 
 
+def probe_remote_free_port(hostname: str, ssh_port=None,
+                           identity_file=None, timeout: float = 20) -> int:
+    """Ask ``hostname``'s kernel for a free ephemeral port over ssh.
+
+    Used for the remote jax.distributed coordinator endpoint: a
+    kernel-assigned ephemeral port is vastly less collision-prone than a
+    blind random pick (the kernel avoids ports in use and cycles the
+    ephemeral range). Raises on ssh failure or unparsable output."""
+    probe = ("python3 -c 'import socket; s=socket.socket(); "
+             "s.bind((\"\", 0)); print(s.getsockname()[1])'")
+    cmd = _ssh_base_cmd(ssh_port, identity_file) + [hostname, probe]
+    out = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, timeout=timeout, env=dict(os.environ))
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"port probe on {hostname} failed: {out.stderr.strip()[:500]}")
+    return int(out.stdout.strip().splitlines()[-1])
+
+
 def check_hosts_ssh(hostnames: list[str], ssh_port=None,
                     identity_file=None) -> None:
     """Fail fast when a remote host is unreachable (reference
@@ -282,8 +302,7 @@ def check_hosts_ssh(hostnames: list[str], ssh_port=None,
     failures = []
 
     def check(h):
-        cmd = ["ssh"] + SSH_OPTIONS + (["-p", str(ssh_port)] if ssh_port else []) \
-            + (["-i", identity_file] if identity_file else []) + [h, "true"]
+        cmd = _ssh_base_cmd(ssh_port, identity_file) + [h, "true"]
         if safe_exec.run(cmd, env=dict(os.environ), prefix_output=False) != 0:
             failures.append(h)
 

@@ -82,14 +82,14 @@ class ExecutedProcess:
     def pid(self) -> int:
         return self.proc.pid
 
-    def _close_owned(self) -> None:
+    def _close_owned(self, blocking: bool = True) -> None:
         # Once the process is dead the pipes hit EOF and the pumps finish on
         # their own; the timeout is just a backstop. Only close the sink
         # files once every pump that writes to them has exited, so a slow
         # drain can't race a closed file.
-        deadline = time.monotonic() + 30.0
+        deadline = time.monotonic() + (30.0 if blocking else 0.0)
         for t in self._pumps:
-            t.join(timeout=max(0.1, deadline - time.monotonic()))
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         if any(t.is_alive() for t in self._pumps):
             return  # keep files open; retry on the next wait()/poll()
         for f in self._owned_files:
@@ -105,9 +105,13 @@ class ExecutedProcess:
         return code
 
     def poll(self) -> int | None:
+        # poll() is conventionally non-blocking and is looped over in
+        # teardown paths (driver round transitions hold locks there) — never
+        # wait on the pump threads here; wait()/terminate() do the blocking
+        # join.
         code = self.proc.poll()
         if code is not None:
-            self._close_owned()
+            self._close_owned(blocking=False)
         return code
 
     def terminate(self) -> None:

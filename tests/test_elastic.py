@@ -370,6 +370,56 @@ class TestElasticDriver:
         finally:
             h.stop()
 
+    def test_scale_down_then_replace_recovers(self):
+        """Discovery must keep polling while a resume() holds the round lock
+        parked in wait_for_available_slots (slots < min_np). Regression for
+        the scale-down-then-replace freeze: blacklisting drops the world
+        below min_np, then a *replacement* host appears and must still be
+        discovered so the waiting round can proceed."""
+        h = DriverHarness({"a": 1, "b": 1}, min_np=2, max_np=2)
+        try:
+            h.start(2)
+            h.wait_for_workers(2)
+            h.procs[("b", 0)][0].exit(1)  # b dies -> blacklist -> 1 slot < min_np
+            time.sleep(1.5)  # resume() is now parked holding _round_lock
+            assert not h.driver.finished()
+            h.discovery.set({"a": 1, "c": 1})  # replacement host appears
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                if h.rendezvous.round_id >= 2 and ("c", 0) in h.procs:
+                    break
+                time.sleep(0.05)
+            assert h.rendezvous.round_id >= 2
+            assert ("c", 0) in h.procs, "replacement host was never activated"
+            assert h.driver.world_size() == 2
+            assert not h.driver.finished()
+        finally:
+            h.stop()
+
+    def test_discovery_defers_update_when_round_lock_held(self):
+        h = DriverHarness({"a": 1}, min_np=1, max_np=2)
+        try:
+            h.start(1)
+            h.wait_for_workers(1)
+            from horovod_tpu.elastic.state import HostUpdateResult
+            assert h.driver._round_lock.acquire(timeout=5)
+            try:
+                # _round_lock is reentrant, so the contended call must come
+                # from another thread (as it does from the discovery thread).
+                t = threading.Thread(
+                    target=h.driver._on_hosts_updated,
+                    args=(HostUpdateResult.added,))
+                t0 = time.monotonic()
+                t.start()
+                t.join(timeout=2.0)
+                assert not t.is_alive(), "_on_hosts_updated blocked on lock"
+                assert time.monotonic() - t0 < 2.0
+                assert h.driver._deferred_update == HostUpdateResult.added
+            finally:
+                h.driver._round_lock.release()
+        finally:
+            h.stop()
+
     def test_reset_limit_stops_job(self):
         h = DriverHarness({"a": 1, "b": 1, "c": 1}, min_np=1, max_np=3,
                           reset_limit=1)

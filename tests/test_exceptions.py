@@ -1,0 +1,53 @@
+"""Recoverable-error classification (elastic retry vs user bug)."""
+
+import pytest
+
+from horovod_tpu.exceptions import (
+    HorovodInternalError,
+    is_recoverable_distributed_error,
+    wrap_internal_errors,
+)
+
+
+class TestRecoverableClassification:
+    def test_gloo_peer_loss_is_recoverable(self):
+        # XLA-CPU surfaces a dead peer as a builtin ValueError.
+        e = ValueError(
+            "UNKNOWN: Gloo all-reduce failed: [gloo/transport/tcp/pair.cc] "
+            "Connection closed by peer [127.0.0.1]:10148")
+        assert is_recoverable_distributed_error(e)
+
+    def test_coordination_service_error_is_recoverable(self):
+        e = RuntimeError("coordination service heartbeat failure")
+        assert is_recoverable_distributed_error(e)
+
+    def test_user_http_503_is_not_recoverable(self):
+        # Regression: broad single-word markers ("unavailable", "peer")
+        # must not swallow ordinary user exceptions into the retry loop.
+        e = RuntimeError("HTTP 503 service unavailable from storage backend")
+        assert not is_recoverable_distributed_error(e)
+
+    def test_user_value_error_is_not_recoverable(self):
+        e = ValueError("peer review of distributed dataset failed")
+        assert not is_recoverable_distributed_error(e)
+
+    def test_jax_typed_errors_use_broad_markers(self):
+        class FakeXlaError(Exception):
+            pass
+        FakeXlaError.__module__ = "jaxlib.xla_extension"
+        assert is_recoverable_distributed_error(
+            FakeXlaError("collective operation deadline exceeded"))
+
+    def test_wrap_translates_recoverable(self):
+        @wrap_internal_errors
+        def boom():
+            raise ValueError("Gloo all-gather failed: Connection reset by peer")
+        with pytest.raises(HorovodInternalError):
+            boom()
+
+    def test_wrap_passes_user_errors(self):
+        @wrap_internal_errors
+        def boom():
+            raise KeyError("missing config key")
+        with pytest.raises(KeyError):
+            boom()
