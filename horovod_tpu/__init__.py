@@ -61,6 +61,9 @@ from .ops import (
     broadcast_object,
     grouped_allreduce,
     grouped_broadcast,
+    hierarchical_allgather,
+    hierarchical_allreduce,
+    hierarchical_mesh,
     join,
     per_rank,
     poll,
@@ -103,6 +106,7 @@ __all__ = [
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
     "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce", "grouped_broadcast",
+    "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
     "ProcessSet", "add_process_set", "global_process_set", "remove_process_set",
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",

@@ -23,6 +23,11 @@ from .collectives import (
     synchronize,
 )
 from .adasum import adasum_allreduce
+from .hierarchical import (
+    hierarchical_allgather,
+    hierarchical_allreduce,
+    hierarchical_mesh,
+)
 
 __all__ = [
     "Adasum", "Average", "Max", "Min", "Product", "ReduceOp", "Sum",
@@ -31,4 +36,5 @@ __all__ = [
     "alltoall_async", "barrier", "broadcast", "broadcast_async",
     "broadcast_object", "grouped_allreduce", "grouped_broadcast", "join", "per_rank", "poll",
     "reducescatter", "synchronize", "adasum_allreduce",
+    "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
 ]

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import runtime
 from ..process_sets import ProcessSet, _resolve
+from . import hierarchical
 from .reduce_ops import ReduceOp, handle_average
 from ..utils import logging as hvd_logging
 
@@ -390,6 +391,13 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
         return tensor if scale == 1.0 else tensor * scale
     lowered_op, post = handle_average(op, pset.size(), postscale_factor)
     bundle, _ = _as_bundle(tensor, pset)
+    if lowered_op == ReduceOp.SUM and hierarchical.hierarchical_enabled_for(pset):
+        # HVD_HIERARCHICAL_ALLREDUCE: two-phase ICI/DCN schedule (the
+        # reference's NCCLHierarchicalAllreduce analog).
+        fn = hierarchical._eager_hier_allreduce_fn(
+            hierarchical.hierarchical_mesh(), lowered_op,
+            float(prescale_factor), float(post))
+        return fn(bundle)[0]
     fn = _eager_allreduce_fn(pset.mesh(), axis, lowered_op,
                              float(prescale_factor), float(post))
     return fn(bundle)[0]
@@ -434,9 +442,14 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     n = pset.size()
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
-    fn = _eager_grouped_allreduce_fn(pset.mesh(), axis, lowered_op,
-                                     float(prescale_factor), float(post),
-                                     len(fused_inputs))
+    if lowered_op == ReduceOp.SUM and hierarchical.hierarchical_enabled_for(pset):
+        fn = hierarchical._eager_hier_grouped_allreduce_fn(
+            hierarchical.hierarchical_mesh(), lowered_op,
+            float(prescale_factor), float(post), len(fused_inputs))
+    else:
+        fn = _eager_grouped_allreduce_fn(pset.mesh(), axis, lowered_op,
+                                         float(prescale_factor), float(post),
+                                         len(fused_inputs))
     fused_outputs = fn(*fused_inputs)
     # row 0 of each (n, total) buffer: identical on every rank
     return _split_fused([buf[0] for buf in fused_outputs], metas, len(tensors))
@@ -464,6 +477,13 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
             "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
             "so the op can lower to an XLA collective.")
     bundle, _ = _as_bundle(tensor, pset)
+    if hierarchical.hierarchical_allgather_enabled_for(pset):
+        # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
+        hmesh = hierarchical.hierarchical_mesh()
+        if bundle.ndim == 1:
+            bundle = bundle[:, None]
+            return hierarchical._eager_hier_allgather_fn(hmesh)(bundle).reshape(-1)
+        return hierarchical._eager_hier_allgather_fn(hmesh)(bundle)
     if bundle.ndim == 1:  # scalars per rank: gather to a vector
         bundle = bundle[:, None]
         return _eager_allgather_fn(pset.mesh(), axis)(bundle).reshape(-1)

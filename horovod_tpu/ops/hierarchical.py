@@ -1,0 +1,271 @@
+"""Hierarchical two-level (ICI/DCN) collectives.
+
+TPU-native rebuild of the reference's ``NCCLHierarchicalAllreduce``
+(``/root/reference/horovod/common/ops/nccl_operations.cc:286-506``: NCCL
+reduce-scatter within the node → cross-node MPI allreduce on the CROSS
+communicator → NCCL allgather back) and ``MPIHierarchicalAllgather``
+(``/root/reference/horovod/common/ops/mpi_operations.cc``). On TPU the two
+levels are the fast intra-slice ICI fabric and the slower inter-slice DCN:
+
+    allreduce(x)  =  psum_scatter(x, ici)  →  psum(piece, dcn)
+                                           →  all_gather(piece, ici)
+
+Each chip moves the full vector twice over ICI but only ``1/ici_size`` of
+it over DCN — the same traffic shape that makes the reference's
+hierarchical path win on >1 node. Enabled with ``HVD_HIERARCHICAL_ALLREDUCE``
+/ ``HVD_HIERARCHICAL_ALLGATHER`` (the reference's knobs, parsed at
+``operations.cc:525-549``); the 2-D shape defaults to
+(processes, chips-per-process) and can be overridden with
+``HVD_HIERARCHICAL_ICI_SIZE``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import runtime
+from ..utils import envs
+from .reduce_ops import ReduceOp
+
+DCN_AXIS = "hvd_dcn"
+ICI_AXIS = "hvd_ici"
+
+
+def default_ici_size() -> int:
+    """Chips per ICI island: ``HVD_HIERARCHICAL_ICI_SIZE`` override, else
+    chips-per-process when homogeneous (the analog of the reference's
+    local communicator, ``common.h:166-170``), else the world size
+    (degenerating to a flat allreduce)."""
+    override = envs.get_int(envs.HIERARCHICAL_ICI_SIZE, 0)
+    if override:
+        return override
+    n = runtime.size()
+    if runtime.is_homogeneous():
+        local = runtime.local_size()
+        if local and n % local == 0:
+            return local
+    return n
+
+
+# (ici_size, runtime generation) -> Mesh. Meshes are immutable; caching per
+# generation mirrors ProcessSet.mesh() so the per-step eager hot path never
+# rebuilds device arrays (a stale-generation mesh would hold dead device
+# objects after shutdown()/init()).
+_mesh_cache: dict = {}
+
+
+def hierarchical_mesh(ici_size: int | None = None) -> Mesh:
+    """2-D ``(dcn, ici)`` mesh over the rank-ordered global devices.
+
+    Rank layout is process-major (``runtime._rank_ordered_devices``), so
+    reshaping to (n // ici, ici) puts each process's chips in one ICI row
+    when ``ici_size`` == chips-per-process."""
+    n = runtime.size()
+    if ici_size is None:
+        ici_size = default_ici_size()
+    if ici_size <= 0 or n % ici_size != 0:
+        raise ValueError(
+            f"hierarchical ici_size {ici_size} must divide world size {n}")
+    key = (ici_size, runtime.generation())
+    mesh = _mesh_cache.get(key)
+    if mesh is None:
+        gen = runtime.generation()
+        for k in [k for k in _mesh_cache if k[1] != gen]:
+            del _mesh_cache[k]  # old generations hold dead device objects
+        devs = runtime.devices()
+        mesh = Mesh(np.array(devs).reshape(n // ici_size, ici_size),
+                    (DCN_AXIS, ICI_AXIS))
+        _mesh_cache[key] = mesh
+    return mesh
+
+
+# ---------------------------------------------------------------------------
+# traced-mode primitives (both axes bound: inside shard_map over a 2-D mesh)
+# ---------------------------------------------------------------------------
+
+def hierarchical_allreduce_traced(x, ici_axis, dcn_axis, *,
+                                  op: ReduceOp = ReduceOp.AVERAGE,
+                                  prescale_factor: float = 1.0,
+                                  postscale_factor: float = 1.0):
+    """Two-phase allreduce with both mesh axes bound (reference
+    ``NCCLHierarchicalAllreduce::Execute``, ``nccl_operations.cc:286-506``).
+
+    Supports SUM/AVERAGE (the reference's hierarchical path is sum-based
+    too; MIN/MAX/PRODUCT fall back to the flat op at the call site).
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"hierarchical allreduce supports SUM/AVERAGE, got {op.name}")
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    n_ici = lax.psum(1, ici_axis)
+    n_total = n_ici * lax.psum(1, dcn_axis)
+
+    orig_dtype = x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n_ici
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # Phase 1: reduce-scatter over the fast ICI axis — each chip ends up
+    # owning 1/n_ici of the (locally reduced) vector.
+    piece = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
+    # Phase 2: allreduce the small piece over the slow DCN axis.
+    piece = lax.psum(piece, dcn_axis)
+    # Phase 3: allgather the fully reduced pieces back over ICI.
+    out = lax.all_gather(piece, ici_axis, tiled=True)
+    out = out[:x.size].reshape(x.shape)
+    if op == ReduceOp.AVERAGE:
+        out = out / jnp.asarray(n_total, out.dtype)
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out.astype(orig_dtype)
+
+
+def hierarchical_allgather_traced(x, ici_axis, dcn_axis):
+    """Two-phase allgather: concat within the ICI island, then across DCN
+    (reference ``MPIHierarchicalAllgather``). Global rank order is
+    dcn-major ici-minor, matching the rank layout of
+    :func:`hierarchical_mesh`."""
+    within = lax.all_gather(x, ici_axis, tiled=True)
+    return lax.all_gather(within, dcn_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# eager machinery: cached jit(shard_map) over the 2-D mesh
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _eager_hier_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float, post: float):
+    dcn_axis, ici_axis = mesh.axis_names
+
+    def inner(x):  # (1, ...) bundle shard -> (1, ...) reduced
+        out = hierarchical_allreduce_traced(
+            x[0], ici_axis, dcn_axis, op=op,
+            prescale_factor=pre, postscale_factor=post)
+        return out[None]
+
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)),
+        out_specs=P((dcn_axis, ici_axis)), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_hier_grouped_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float,
+                                     post: float, num_bufs: int):
+    dcn_axis, ici_axis = mesh.axis_names
+
+    def inner(*xs):
+        return tuple(
+            hierarchical_allreduce_traced(
+                x[0], ici_axis, dcn_axis, op=op,
+                prescale_factor=pre, postscale_factor=post)[None]
+            for x in xs)
+
+    specs = tuple(P((dcn_axis, ici_axis)) for _ in range(num_bufs))
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=specs, out_specs=specs, check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_hier_allgather_fn(mesh: Mesh):
+    dcn_axis, ici_axis = mesh.axis_names
+
+    def inner(x):  # (1, d0, ...) -> (n*d0, ...) replicated
+        return hierarchical_allgather_traced(x[0], ici_axis, dcn_axis)
+
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)),
+        out_specs=P(), check_vma=False))
+
+
+def _enabled(knob: str, pset) -> bool:
+    """Whether the eager hierarchical path applies: knob set, global set
+    (the reference only runs hierarchical on the global communicator), and
+    a non-trivial 2-D factorization exists."""
+    if not envs.get_bool(knob):
+        return False
+    if not pset.is_global:
+        return False
+    ici = default_ici_size()
+    return 1 < ici < runtime.size() and runtime.size() % ici == 0
+
+
+def hierarchical_enabled_for(pset) -> bool:
+    return _enabled(envs.HIERARCHICAL_ALLREDUCE, pset)
+
+
+def hierarchical_allgather_enabled_for(pset) -> bool:
+    return _enabled(envs.HIERARCHICAL_ALLGATHER, pset)
+
+
+# ---------------------------------------------------------------------------
+# public API (explicit two-level ops; hvd.allreduce also routes here when
+# HVD_HIERARCHICAL_ALLREDUCE is set)
+# ---------------------------------------------------------------------------
+
+def hierarchical_allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0,
+                           ici_size: int | None = None,
+                           ici_axis: str | None = None,
+                           dcn_axis: str | None = None,
+                           name: str | None = None):
+    """Explicit two-level allreduce.
+
+    Traced mode: call inside ``shard_map`` over a 2-D mesh and pass the
+    bound ``ici_axis``/``dcn_axis`` names. Eager mode: runs over
+    :func:`hierarchical_mesh` (global process set only)."""
+    del name
+    from .collectives import _as_bundle, _axis_is_bound, _contains_tracer
+    from .reduce_ops import handle_average
+    ia = ici_axis or ICI_AXIS
+    da = dcn_axis or DCN_AXIS
+    if _axis_is_bound(ia) and _axis_is_bound(da):
+        return hierarchical_allreduce_traced(
+            tensor, ia, da, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+    if _contains_tracer(tensor):
+        raise RuntimeError(
+            "hierarchical_allreduce() inside jit/pjit requires both mesh "
+            "axes bound; run it under jax.shard_map over "
+            "hvd.hierarchical_mesh() and pass ici_axis=/dcn_axis=.")
+    from ..process_sets import global_process_set
+    mesh = hierarchical_mesh(ici_size)
+    lowered, post = handle_average(op, runtime.size(), postscale_factor)
+    bundle, _ = _as_bundle(tensor, global_process_set)
+    fn = _eager_hier_allreduce_fn(mesh, lowered, float(prescale_factor),
+                                  float(post))
+    return fn(bundle)[0]
+
+
+def hierarchical_allgather(tensor, *, ici_size: int | None = None,
+                           ici_axis: str | None = None,
+                           dcn_axis: str | None = None,
+                           name: str | None = None):
+    """Explicit two-level allgather (concat along dim 0 in global rank
+    order). Traced with both axes bound, else eager over
+    :func:`hierarchical_mesh`."""
+    del name
+    from .collectives import _as_bundle, _axis_is_bound, _contains_tracer
+    ia = ici_axis or ICI_AXIS
+    da = dcn_axis or DCN_AXIS
+    if _axis_is_bound(ia) and _axis_is_bound(da):
+        return hierarchical_allgather_traced(tensor, ia, da)
+    if _contains_tracer(tensor):
+        raise RuntimeError(
+            "hierarchical_allgather() inside jit/pjit requires both mesh "
+            "axes bound; run it under jax.shard_map over "
+            "hvd.hierarchical_mesh() and pass ici_axis=/dcn_axis=.")
+    from ..process_sets import global_process_set
+    mesh = hierarchical_mesh(ici_size)
+    bundle, _ = _as_bundle(tensor, global_process_set)
+    if bundle.ndim == 1:  # scalars per rank: gather to a vector
+        bundle = bundle[:, None]
+        return _eager_hier_allgather_fn(mesh)(bundle).reshape(-1)
+    return _eager_hier_allgather_fn(mesh)(bundle)

@@ -32,6 +32,7 @@ STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"  # reference warns at 60 s
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"
 HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
+HIERARCHICAL_ICI_SIZE = "HIERARCHICAL_ICI_SIZE"  # chips per ICI island; default local_size
 BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"

@@ -1,0 +1,212 @@
+"""Hierarchical two-level (ICI/DCN) collectives.
+
+Validates the reference-parity schedule (reduce-scatter over ICI → allreduce
+over DCN → allgather over ICI, ``nccl_operations.cc:286-506``) numerically
+against the flat path on an 8-device world reshaped 2×4.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import hierarchical
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+def _world():
+    return hvd.size()
+
+
+class TestHierarchicalMesh:
+    def test_shape(self):
+        m = hvd.hierarchical_mesh(ici_size=4)
+        assert m.axis_names == (hierarchical.DCN_AXIS, hierarchical.ICI_AXIS)
+        assert m.devices.shape == (_world() // 4, 4)
+
+    def test_bad_ici_size(self):
+        with pytest.raises(ValueError):
+            hvd.hierarchical_mesh(ici_size=3)
+
+    def test_default_ici_size_env_override(self, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "2")
+        assert hierarchical.default_ici_size() == 2
+
+
+class TestTracedHierarchicalAllreduce:
+    @pytest.mark.parametrize("shape", [(16,), (5,), (3, 7), (2, 3, 4)])
+    def test_matches_flat_sum(self, rng, shape):
+        n = _world()
+        data = rng.normal(size=(n,) + shape).astype(np.float32)
+        mesh = hvd.hierarchical_mesh(ici_size=4)
+        da, ia = mesh.axis_names
+
+        def inner(x):
+            return hierarchical.hierarchical_allreduce_traced(
+                x[0], ia, da, op=hvd.Sum)[None]
+
+        fn = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=P((da, ia)), out_specs=P((da, ia)),
+            check_vma=False))
+        out = np.asarray(fn(data)[0])
+        np.testing.assert_allclose(out, data.sum(axis=0), rtol=1e-5)
+
+    def test_average(self, rng):
+        n = _world()
+        data = rng.normal(size=(n, 9)).astype(np.float32)
+        mesh = hvd.hierarchical_mesh(ici_size=2)
+        da, ia = mesh.axis_names
+
+        def inner(x):
+            return hierarchical.hierarchical_allreduce_traced(
+                x[0], ia, da, op=hvd.Average)[None]
+
+        fn = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=P((da, ia)), out_specs=P((da, ia)),
+            check_vma=False))
+        out = np.asarray(fn(data)[0])
+        np.testing.assert_allclose(out, data.mean(axis=0), rtol=1e-5)
+
+    def test_prescale_postscale(self, rng):
+        n = _world()
+        data = rng.normal(size=(n, 4)).astype(np.float32)
+        mesh = hvd.hierarchical_mesh(ici_size=4)
+        da, ia = mesh.axis_names
+
+        def inner(x):
+            return hierarchical.hierarchical_allreduce_traced(
+                x[0], ia, da, op=hvd.Sum, prescale_factor=2.0,
+                postscale_factor=0.5)[None]
+
+        fn = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=P((da, ia)), out_specs=P((da, ia)),
+            check_vma=False))
+        out = np.asarray(fn(data)[0])
+        np.testing.assert_allclose(out, data.sum(axis=0), rtol=1e-5)
+
+    def test_rejects_min(self):
+        mesh = hvd.hierarchical_mesh(ici_size=4)
+        da, ia = mesh.axis_names
+        with pytest.raises(ValueError, match="SUM/AVERAGE"):
+            jax.shard_map(
+                lambda x: hierarchical.hierarchical_allreduce_traced(
+                    x[0], ia, da, op=hvd.Min)[None],
+                mesh=mesh, in_specs=P((da, ia)), out_specs=P((da, ia)),
+                check_vma=False)(np.zeros((_world(), 2), np.float32))
+
+
+class TestTracedHierarchicalAllgather:
+    def test_matches_concat_in_rank_order(self, rng):
+        n = _world()
+        data = rng.normal(size=(n, 2, 3)).astype(np.float32)
+        mesh = hvd.hierarchical_mesh(ici_size=4)
+        da, ia = mesh.axis_names
+
+        def inner(x):
+            return hierarchical.hierarchical_allgather_traced(x[0], ia, da)
+
+        fn = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=P((da, ia)), out_specs=P(),
+            check_vma=False))
+        out = np.asarray(fn(data))
+        np.testing.assert_allclose(out, data.reshape(n * 2, 3), rtol=1e-6)
+
+
+class TestEagerHierarchical:
+    def test_public_allreduce(self, rng):
+        n = _world()
+        vals = [rng.normal(size=(6, 2)).astype(np.float32) for _ in range(n)]
+        out = hvd.hierarchical_allreduce(hvd.per_rank(vals), op=hvd.Sum,
+                                         ici_size=4)
+        np.testing.assert_allclose(np.asarray(out), np.sum(vals, axis=0),
+                                   rtol=1e-5)
+
+    def test_public_allreduce_average(self, rng):
+        n = _world()
+        vals = [rng.normal(size=(5,)).astype(np.float32) for _ in range(n)]
+        out = hvd.hierarchical_allreduce(hvd.per_rank(vals), ici_size=2)
+        np.testing.assert_allclose(np.asarray(out), np.mean(vals, axis=0),
+                                   rtol=1e-5)
+
+    def test_public_allgather(self, rng):
+        n = _world()
+        vals = [rng.normal(size=(2, 3)).astype(np.float32) for _ in range(n)]
+        out = hvd.hierarchical_allgather(hvd.per_rank(vals), ici_size=4)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.concatenate(vals, axis=0), rtol=1e-6)
+
+    def test_knob_routes_allreduce(self, rng, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        n = _world()
+        vals = [rng.normal(size=(7,)).astype(np.float32) for _ in range(n)]
+        out = hvd.allreduce(hvd.per_rank(vals), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), np.sum(vals, axis=0),
+                                   rtol=1e-5)
+        out = hvd.allreduce(hvd.per_rank(vals))  # AVERAGE
+        np.testing.assert_allclose(np.asarray(out), np.mean(vals, axis=0),
+                                   rtol=1e-5)
+
+    def test_knob_routes_grouped_allreduce(self, rng, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "2")
+        n = _world()
+        a = [rng.normal(size=(3,)).astype(np.float32) for _ in range(n)]
+        b = [rng.normal(size=(2, 2)).astype(np.float32) for _ in range(n)]
+        outs = hvd.grouped_allreduce([hvd.per_rank(a), hvd.per_rank(b)],
+                                     op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(outs[0]), np.sum(a, axis=0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[1]), np.sum(b, axis=0),
+                                   rtol=1e-5)
+
+    def test_knob_routes_allgather(self, rng, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ALLGATHER", "1")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        n = _world()
+        vals = [rng.normal(size=(2,)).astype(np.float32) for _ in range(n)]
+        out = hvd.allgather(hvd.per_rank(vals))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.concatenate(vals, axis=0), rtol=1e-6)
+
+    def test_knob_ignored_for_subset(self, rng, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        ps = hvd.add_process_set([0, 1, 2])
+        try:
+            vals = [rng.normal(size=(3,)).astype(np.float32) for _ in range(3)]
+            out = hvd.allreduce(hvd.per_rank(vals, ps), op=hvd.Sum,
+                                process_set=ps)
+            np.testing.assert_allclose(np.asarray(out), np.sum(vals, axis=0),
+                                       rtol=1e-5)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_min_max_fall_back_to_flat(self, rng, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        n = _world()
+        vals = [rng.normal(size=(4,)).astype(np.float32) for _ in range(n)]
+        out = hvd.allreduce(hvd.per_rank(vals), op=hvd.Min)
+        np.testing.assert_allclose(np.asarray(out), np.min(vals, axis=0),
+                                   rtol=1e-6)
+
+    def test_bf16(self, rng, monkeypatch):
+        monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        n = _world()
+        vals = [rng.normal(size=(8,)).astype(jnp.bfloat16) for _ in range(n)]
+        out = hvd.allreduce(hvd.per_rank(vals), op=hvd.Sum)
+        assert out.dtype == jnp.bfloat16
+        expected = np.sum([np.asarray(v, np.float32) for v in vals], axis=0)
+        np.testing.assert_allclose(np.asarray(out, np.float32), expected,
+                                   rtol=0.05)
