@@ -11,8 +11,11 @@ Five-line usage, mirroring the reference README (``/root/reference/README.rst``)
 
 Hot-path inversion (SURVEY.md §7): the reference injects a C++ background
 runtime between the framework and NCCL/MPI; here the XLA compiler schedules
-collectives natively over the ICI/DCN mesh. A dynamic-dispatch engine
-(fusion/negotiation/caching) exists for eager-mode parity.
+collectives natively over the ICI/DCN mesh. A native (C++) dynamic engine —
+negotiation, response cache, fusion planning, stall inspection, Chrome-trace
+timeline — is built on demand from ``native/`` and bound via ctypes
+(:mod:`horovod_tpu.dynamic`); the eager collectives record into its
+timeline (``hvd.start_timeline``).
 """
 
 from . import runtime as _runtime
@@ -88,6 +91,7 @@ from .functions import (
     broadcast_variables,
 )
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .timeline import start_timeline, stop_timeline
 from . import elastic
 from .version import __version__
 
@@ -112,5 +116,5 @@ __all__ = [
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
-    "elastic", "__version__",
+    "start_timeline", "stop_timeline", "elastic", "__version__",
 ]

@@ -127,7 +127,7 @@ class WorkerRendezvous:
             spec["round"], my_slot["rank"], spec["world_size"],
             spec["coord_addr"], spec["coord_port"])
 
-        runtime.shutdown()
+        runtime.shutdown()  # also stops the old-world negotiation service
         jax.config.update("jax_enable_recoverability", True)
         try:
             jax.distributed.shutdown()

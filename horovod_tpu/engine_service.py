@@ -1,0 +1,335 @@
+"""Background negotiation service for multi-process eager collectives.
+
+The TPU-native analog of the reference's background thread loop
+(``BackgroundThreadLoop`` → ``RunLoopOnce`` every ``CycleTimeMs``,
+``operations.cc:385-806``): each controller process ticks the symmetric
+negotiation protocol of :mod:`horovod_tpu.dynamic` over the launcher's HTTP
+KV store. Eager collectives in multi-process jobs call :func:`negotiate`
+before executing — the service guarantees
+
+* every process executes collectives in the identical globally-agreed
+  order (the reference's core ordering guarantee, ``operations.cc:363-382``),
+* metadata disagreements (shape/dtype/op/root) surface as informative
+  :class:`~horovod_tpu.dynamic.HorovodCollectiveError`\\ s instead of hangs
+  or corrupt reductions (``ConstructResponse`` ERRORs, ``controller.cc``),
+* tensors submitted by some-but-not-all processes are reported by the
+  stall inspector after ``HVD_STALL_CHECK_TIME_SECONDS`` (default 60 s,
+  ``stall_inspector.h:75-86``).
+
+Single-process jobs (the normal SPMD single-controller case) never start
+the service: one process sees every rank's data, so ordering and metadata
+agreement hold by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import timeline as _timeline
+from .dynamic import (
+    HorovodCollectiveError,
+    NativeEngine,
+    Response,
+    and_bitvectors,
+)
+from .utils import envs
+from .utils import logging as hvd_logging
+
+# Default cycle time over the HTTP KV transport. The reference's 1 ms
+# default assumes an in-process MPI transport; an HTTP KV round costs
+# single-digit milliseconds, so ticking faster only burns CPU.
+DEFAULT_KV_CYCLE_TIME_MS = 20.0
+_STALL_CHECK_INTERVAL_S = 5.0
+
+
+class KVTransport:
+    """Allgather/AND over the launcher KV server (the analog of the
+    reference controller's MPI_Gatherv/Bcast transport,
+    ``mpi_controller.cc:135-207``)."""
+
+    def __init__(self, kv_client, world_size: int, rank: int,
+                 prefix: str = "engine"):
+        self.kv = kv_client
+        self.world_size = world_size
+        self.rank = rank
+        self.prefix = prefix
+
+    def _gather(self, kind: str, cycle: int, mine: bytes,
+                timeout: float) -> list[bytes]:
+        self.kv.put(f"{self.prefix}/{kind}/{cycle}/{self.rank}", mine)
+        out = []
+        for r in range(self.world_size):
+            if r == self.rank:
+                out.append(mine)
+                continue
+            data = self.kv.wait(f"{self.prefix}/{kind}/{cycle}/{r}",
+                                timeout=timeout)
+            out.append(data)
+        # Everyone read cycle-c data before anyone can write cycle c+2 (a
+        # process must finish cycle c+1's own reads first), so deleting our
+        # *previous* cycle's keys here is safe and bounds KV memory.
+        if cycle > 0:
+            try:
+                self.kv.delete(f"{self.prefix}/{kind}/{cycle - 1}/{self.rank}")
+            except Exception:
+                pass
+        return out
+
+    def exchange_requests(self, cycle: int, mine: bytes,
+                          timeout: float) -> list[bytes]:
+        return self._gather("req", cycle, mine, timeout)
+
+    def and_bits(self, cycle: int, mine: bytes, timeout: float) -> bytes:
+        return and_bitvectors(self._gather("bits", cycle, mine, timeout))
+
+
+class _Pending:
+    __slots__ = ("event", "response")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.response: Response | None = None
+
+
+class DynamicService:
+    """Owns one engine + transport and ticks negotiation cycles on a
+    background thread."""
+
+    def __init__(self, engine: NativeEngine, transport,
+                 cycle_time_s: float | None = None):
+        self.engine = engine
+        self.transport = transport
+        if cycle_time_s is None:
+            cycle_time_s = envs.get_float(
+                envs.CYCLE_TIME, DEFAULT_KV_CYCLE_TIME_MS) / 1000.0
+        self.cycle_time_s = cycle_time_s
+        self._cycle = 0
+        self._mu = threading.Lock()
+        self._pending: dict[str, _Pending] = {}
+        self._failure: str | None = None
+        self._shutdown = threading.Event()
+        self._exchange_timeout = envs.get_float(envs.ELASTIC_TIMEOUT, 600.0)
+        self._last_stall_check = time.monotonic()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-engine-cycle")
+        self._thread.start()
+
+    # -- public ------------------------------------------------------------
+
+    def negotiate(self, name: str, request_type: int, *, dtype: int = 0,
+                  element_size: int = 4, shape=(), root_rank: int = -1,
+                  group_id: int = -1,
+                  timeout: float | None = None) -> Response:
+        """Enqueue a request and block until the global plan includes it
+        (the eager analog of ``EnqueueTensorAllreduce`` + handle wait)."""
+        return self.negotiate_many([dict(
+            name=name, request_type=request_type, dtype=dtype,
+            element_size=element_size, shape=shape, root_rank=root_rank,
+            group_id=group_id)], timeout=timeout)[0]
+
+    def negotiate_many(self, requests: list[dict],
+                       timeout: float | None = None) -> list[Response]:
+        """Enqueue a batch (e.g. one grouped op) and wait for all plans —
+        all requests land in one cycle, so the wait is one round trip."""
+        if self._failure:
+            raise HorovodCollectiveError(self._failure)
+        pends = []
+        with self._mu:
+            for req in requests:
+                name = req["name"]
+                if name in self._pending:
+                    from .dynamic import DuplicateNameError
+                    raise DuplicateNameError(
+                        f"tensor name {name!r} is already being negotiated; "
+                        "pass a unique name=")
+            for req in requests:
+                pend = _Pending()
+                self._pending[req["name"]] = pend
+                pends.append(pend)
+                self.engine.enqueue(
+                    req["name"], req["request_type"],
+                    dtype=req.get("dtype", 0),
+                    element_size=req.get("element_size", 4),
+                    shape=req.get("shape", ()),
+                    root_rank=req.get("root_rank", -1),
+                    group_id=req.get("group_id", -1))
+        for req in requests:
+            _timeline.record(req["name"], _timeline.NEGOTIATE,
+                             _timeline.PHASE_BEGIN)
+        deadline = (timeout if timeout is not None
+                    else self._exchange_timeout)
+        end = time.monotonic() + deadline
+        try:
+            for req, pend in zip(requests, pends):
+                remaining = end - time.monotonic()
+                if remaining <= 0 or not pend.event.wait(remaining):
+                    raise HorovodCollectiveError(
+                        f"negotiation of {req['name']!r} timed out after "
+                        f"{deadline}s (some processes never submitted it; "
+                        "see stall warnings in the log)")
+        finally:
+            for req in requests:
+                _timeline.record(req["name"], _timeline.NEGOTIATE,
+                                 _timeline.PHASE_END)
+            with self._mu:
+                for req in requests:
+                    self._pending.pop(req["name"], None)
+        out = []
+        for req, pend in zip(requests, pends):
+            resp = pend.response
+            if resp is None:
+                raise HorovodCollectiveError(
+                    self._failure or f"negotiation of {req['name']!r} aborted")
+            if resp.is_error:
+                raise HorovodCollectiveError(resp.error_message)
+            out.append(resp)
+        return out
+
+    def stop(self):
+        self._shutdown.set()
+        self._thread.join(timeout=10)
+        self._fail_all("engine service stopped")
+
+    # -- internals ---------------------------------------------------------
+
+    def _fail_all(self, message: str):
+        self._failure = message
+        with self._mu:
+            pend = list(self._pending.values())
+            self._pending.clear()
+        for p in pend:
+            p.event.set()
+
+    def _loop(self):
+        while not self._shutdown.is_set():
+            start = time.monotonic()
+            try:
+                self._run_cycle()
+            except Exception as e:
+                hvd_logging.exception("engine cycle failed")
+                self._fail_all(f"engine negotiation failed: {e}")
+                return
+            elapsed = time.monotonic() - start
+            self._shutdown.wait(max(0.0, self.cycle_time_s - elapsed))
+
+    def _run_cycle(self):
+        mine = self.engine.pop_requests()
+        cycle = self._cycle
+        self._cycle += 1
+        datas = self.transport.exchange_requests(cycle, mine,
+                                                 self._exchange_timeout)
+        for rank, data in enumerate(datas):
+            self.engine.ingest(rank, data)
+        anded = self.transport.and_bits(cycle, self.engine.cache_bits(),
+                                        self._exchange_timeout)
+        self.engine.commit_cache_bits(anded)
+        responses = self.engine.compute_responses()
+        if responses:
+            self._deliver(responses)
+        now = time.monotonic()
+        if now - self._last_stall_check > _STALL_CHECK_INTERVAL_S:
+            self._last_stall_check = now
+            self._check_stalls()
+
+    def _deliver(self, responses: list[Response]):
+        with self._mu:
+            for resp in responses:
+                for tname in resp.tensor_names:
+                    pend = self._pending.get(tname)
+                    if pend is not None:
+                        pend.response = resp
+                        pend.event.set()
+
+    def _check_stalls(self):
+        if envs.get_bool(envs.STALL_CHECK_DISABLE):
+            return
+        report, shutdown = self.engine.stall_report()
+        for entry in report:
+            hvd_logging.warning(
+                "One or more tensors were submitted to be reduced/gathered "
+                "but were not ready on all processes for %.0f seconds. This "
+                "may indicate diverged control flow. Tensor: %s, ready "
+                "ranks: %s, missing ranks: %s",
+                entry.waiting_seconds, entry.tensor_name, entry.ready_ranks,
+                entry.missing_ranks(self.engine.world_size))
+        if shutdown:
+            self._fail_all(
+                "stalled tensors exceeded HVD_STALL_SHUTDOWN_TIME_SECONDS; "
+                "shutting down negotiation (reference semantics, "
+                "stall_inspector.h:71-86)")
+            self._shutdown.set()
+
+
+# --------------------------------------------------------------------------
+# process-wide service (created lazily for multi-process eager jobs)
+# --------------------------------------------------------------------------
+
+_service: DynamicService | None = None
+_service_lock = threading.Lock()
+_service_unavailable = False
+
+
+def get_service() -> DynamicService | None:
+    """The process's negotiation service, or None when not applicable
+    (single-process job, knob disabled, no launcher KV, native engine
+    unavailable)."""
+    global _service, _service_unavailable
+    if _service is not None:
+        return _service
+    if _service_unavailable:
+        return None
+    if not envs.get_bool("DYNAMIC_ENGINE", True):
+        _service_unavailable = True
+        return None
+    from . import runtime
+    if not runtime.is_initialized() or runtime.process_count() <= 1:
+        return None  # may become multi-process after a later init
+    kv_addr = envs.get(envs.KV_ADDR)
+    if not kv_addr:
+        _service_unavailable = True
+        return None
+    with _service_lock:
+        if _service is not None or _service_unavailable:
+            return _service
+        try:
+            from ._native import available
+            if not available():
+                _service_unavailable = True
+                return None
+            from .runner.http_kv import KVClient
+            kv = KVClient(kv_addr, envs.get_int(envs.KV_PORT, 0),
+                          secret=envs.get(envs.SECRET_KEY))
+            engine = NativeEngine(world_size=runtime.process_count(),
+                                  rank=runtime.process_rank())
+            # Scope keys to this world instance: the coordinator endpoint
+            # changes every elastic round, so a fresh service can never
+            # read stale cycle keys left by the previous round.
+            prefix = "engine/{}:{}".format(
+                envs.get(envs.COORDINATOR_ADDR, "local"),
+                envs.get(envs.COORDINATOR_PORT, "0"))
+            transport = KVTransport(kv, runtime.process_count(),
+                                    runtime.process_rank(), prefix=prefix)
+            _service = DynamicService(engine, transport)
+            hvd_logging.info(
+                "dynamic engine service started: %d processes over KV %s",
+                runtime.process_count(), kv_addr)
+        except Exception as e:
+            hvd_logging.warning("dynamic engine service unavailable: %s", e)
+            _service_unavailable = True
+    return _service
+
+
+def reset_service() -> None:
+    """Tear down the process service (elastic re-init / tests)."""
+    global _service, _service_unavailable
+    with _service_lock:
+        if _service is not None:
+            _service.stop()
+            _service = None
+        _service_unavailable = False
+    # Auto-generated op names must restart from zero everywhere after a
+    # world reset: surviving workers would otherwise keep counting while
+    # replacement workers start at 0, desynchronizing negotiation names.
+    from .ops import collectives as _coll
+    _coll._auto_counters.clear()

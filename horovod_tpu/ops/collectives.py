@@ -40,6 +40,15 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import runtime
+from .. import timeline as _timeline
+from ..dynamic import (
+    REQ_ALLGATHER,
+    REQ_ALLREDUCE,
+    REQ_ALLTOALL,
+    REQ_BARRIER,
+    REQ_BROADCAST,
+    REQ_REDUCESCATTER,
+)
 from ..process_sets import ProcessSet, _resolve
 from . import hierarchical
 from .reduce_ops import ReduceOp, handle_average
@@ -358,6 +367,86 @@ def _check_op_dtype(op: ReduceOp, dtype):
 
 
 # ---------------------------------------------------------------------------
+# multi-process eager negotiation (dynamic engine gate)
+# ---------------------------------------------------------------------------
+
+import itertools as _itertools
+
+# Stable dtype ids for cross-process metadata agreement checks (only
+# equality matters; the table must be identical on every process).
+_DTYPE_IDS = {name: i for i, name in enumerate((
+    "bool", "int8", "uint8", "int16", "uint16", "int32", "uint32", "int64",
+    "uint64", "float16", "bfloat16", "float32", "float64", "complex64",
+    "complex128"))}
+
+
+def _dtype_id(dt) -> int:
+    known = _DTYPE_IDS.get(dt.name)
+    if known is not None:
+        return known
+    # Unlisted dtypes (fp8 variants etc.) get a deterministic id derived
+    # from the name — crc32 is stable across processes, unlike hash().
+    import zlib
+    return 0x4000_0000 | (zlib.crc32(dt.name.encode()) & 0x3FFF_FFFF)
+
+
+_auto_counters: dict[str, object] = {}
+
+
+def _auto_name(kind: str) -> str:
+    counter = _auto_counters.setdefault(kind, _itertools.count())
+    return f"{kind}.{next(counter)}"
+
+
+def _negotiate_eager(kind: str, request_type: int, name: str | None,
+                     shape, dtype, pset: ProcessSet,
+                     root_rank: int = -1) -> None:
+    """Gate a multi-process eager collective through the dynamic engine
+    (no-op for single-process jobs). Guarantees identical global op order
+    and turns metadata disagreements into informative errors instead of
+    hangs/corrupt reductions (the reference's negotiation role,
+    ``controller.cc:73-430``).
+
+    Only global-set collectives negotiate: a subset process set may exclude
+    entire processes, which legally never submit the op — negotiating over
+    the world would report a false stall (the reference runs a separate
+    controller per process set instead; subset validation is future work).
+    """
+    if not pset.is_global:
+        return
+    from .. import engine_service
+    svc = engine_service.get_service()
+    if svc is None:
+        return
+    dt = jnp.dtype(dtype)
+    svc.negotiate(name or _auto_name(kind), request_type,
+                  dtype=_dtype_id(dt),
+                  element_size=dt.itemsize, shape=tuple(shape),
+                  root_rank=root_rank)
+
+
+def _negotiate_eager_group(kind: str, request_type: int, name: str | None,
+                           shapes_dtypes, pset: ProcessSet,
+                           root_rank: int = -1) -> None:
+    """Batch variant for grouped ops: all members land in one cycle."""
+    if not pset.is_global:
+        return
+    from .. import engine_service
+    svc = engine_service.get_service()
+    if svc is None:
+        return
+    base = name or _auto_name(kind)
+    reqs = []
+    for i, (shape, dtype) in enumerate(shapes_dtypes):
+        dt = jnp.dtype(dtype)
+        reqs.append(dict(name=f"{base}.{i}", request_type=request_type,
+                         dtype=_dtype_id(dt),
+                         element_size=dt.itemsize, shape=tuple(shape),
+                         root_rank=root_rank))
+    svc.negotiate_many(reqs)
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
@@ -367,8 +456,8 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
               name: str | None = None, axis_name=None):
     """Allreduce (reference ``hvd.allreduce``; enqueue path
     ``operations.cc:1357-1512``). AVERAGE lowers to SUM + postscale 1/n
-    (``operations.cc:1408-1416``)."""
-    del name
+    (``operations.cc:1408-1416``). ``name`` labels the op in the timeline
+    (``hvd.start_timeline``)."""
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
     _check_op_dtype(op, jnp.result_type(tensor if not isinstance(tensor, PerRank)
@@ -391,16 +480,20 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
         return tensor if scale == 1.0 else tensor * scale
     lowered_op, post = handle_average(op, pset.size(), postscale_factor)
     bundle, _ = _as_bundle(tensor, pset)
-    if lowered_op == ReduceOp.SUM and hierarchical.hierarchical_enabled_for(pset):
-        # HVD_HIERARCHICAL_ALLREDUCE: two-phase ICI/DCN schedule (the
-        # reference's NCCLHierarchicalAllreduce analog).
-        fn = hierarchical._eager_hier_allreduce_fn(
-            hierarchical.hierarchical_mesh(), lowered_op,
-            float(prescale_factor), float(post))
+    _negotiate_eager("allreduce", REQ_ALLREDUCE, name, bundle.shape[1:],
+                     bundle.dtype)
+    with _timeline.op_range(name or "allreduce", "ALLREDUCE"):
+        if (lowered_op == ReduceOp.SUM
+                and hierarchical.hierarchical_enabled_for(pset)):
+            # HVD_HIERARCHICAL_ALLREDUCE: two-phase ICI/DCN schedule (the
+            # reference's NCCLHierarchicalAllreduce analog).
+            fn = hierarchical._eager_hier_allreduce_fn(
+                hierarchical.hierarchical_mesh(), lowered_op,
+                float(prescale_factor), float(post))
+            return fn(bundle)[0]
+        fn = _eager_allreduce_fn(pset.mesh(), axis, lowered_op,
+                                 float(prescale_factor), float(post))
         return fn(bundle)[0]
-    fn = _eager_allreduce_fn(pset.mesh(), axis, lowered_op,
-                             float(prescale_factor), float(post))
-    return fn(bundle)[0]
 
 
 def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
@@ -415,7 +508,6 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     reference's fusion buffer, ``fusion_buffer_manager.h:30-50``), reduced
     in one compiled program, then split back.
     """
-    del name
     if not tensors:
         return []
     pset = _resolve(process_set)
@@ -442,15 +534,19 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     n = pset.size()
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
-    if lowered_op == ReduceOp.SUM and hierarchical.hierarchical_enabled_for(pset):
-        fn = hierarchical._eager_hier_grouped_allreduce_fn(
-            hierarchical.hierarchical_mesh(), lowered_op,
-            float(prescale_factor), float(post), len(fused_inputs))
-    else:
-        fn = _eager_grouped_allreduce_fn(pset.mesh(), axis, lowered_op,
-                                         float(prescale_factor), float(post),
-                                         len(fused_inputs))
-    fused_outputs = fn(*fused_inputs)
+    _negotiate_eager_group("grouped_allreduce", REQ_ALLREDUCE, name,
+                           [(b.shape[1:], b.dtype) for b in bundles])
+    with _timeline.op_range(name or "grouped_allreduce", "GROUPED_ALLREDUCE"):
+        if (lowered_op == ReduceOp.SUM
+                and hierarchical.hierarchical_enabled_for(pset)):
+            fn = hierarchical._eager_hier_grouped_allreduce_fn(
+                hierarchical.hierarchical_mesh(), lowered_op,
+                float(prescale_factor), float(post), len(fused_inputs))
+        else:
+            fn = _eager_grouped_allreduce_fn(pset.mesh(), axis, lowered_op,
+                                             float(prescale_factor),
+                                             float(post), len(fused_inputs))
+        fused_outputs = fn(*fused_inputs)
     # row 0 of each (n, total) buffer: identical on every rank
     return _split_fused([buf[0] for buf in fused_outputs], metas, len(tensors))
 
@@ -465,7 +561,6 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
     the reference's ragged first dimension is supported via
     :func:`allgather_object` or explicit padding.
     """
-    del name
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
     if _axis_is_bound(axis):
@@ -477,24 +572,27 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
             "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
             "so the op can lower to an XLA collective.")
     bundle, _ = _as_bundle(tensor, pset)
-    if hierarchical.hierarchical_allgather_enabled_for(pset):
-        # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
-        hmesh = hierarchical.hierarchical_mesh()
-        if bundle.ndim == 1:
+    _negotiate_eager("allgather", REQ_ALLGATHER, name, bundle.shape[1:],
+                     bundle.dtype)
+    with _timeline.op_range(name or "allgather", "ALLGATHER"):
+        if hierarchical.hierarchical_allgather_enabled_for(pset):
+            # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
+            hmesh = hierarchical.hierarchical_mesh()
+            if bundle.ndim == 1:
+                bundle = bundle[:, None]
+                return hierarchical._eager_hier_allgather_fn(hmesh)(
+                    bundle).reshape(-1)
+            return hierarchical._eager_hier_allgather_fn(hmesh)(bundle)
+        if bundle.ndim == 1:  # scalars per rank: gather to a vector
             bundle = bundle[:, None]
-            return hierarchical._eager_hier_allgather_fn(hmesh)(bundle).reshape(-1)
-        return hierarchical._eager_hier_allgather_fn(hmesh)(bundle)
-    if bundle.ndim == 1:  # scalars per rank: gather to a vector
-        bundle = bundle[:, None]
-        return _eager_allgather_fn(pset.mesh(), axis)(bundle).reshape(-1)
-    return _eager_allgather_fn(pset.mesh(), axis)(bundle)
+            return _eager_allgather_fn(pset.mesh(), axis)(bundle).reshape(-1)
+        return _eager_allgather_fn(pset.mesh(), axis)(bundle)
 
 
 def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
               name: str | None = None, axis_name=None):
     """Broadcast from ``root_rank`` (a *global* rank, as in the reference's
     ``hvd.broadcast``; ``operations.cc:1568``)."""
-    del name
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
     if root_rank not in pset.ranks:
@@ -509,7 +607,10 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
             "so the op can lower to an XLA collective.")
     bundle, _ = _as_bundle(tensor, pset)
     root_pos = pset.ranks.index(root_rank)
-    return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
+    _negotiate_eager("broadcast", REQ_BROADCAST, name, bundle.shape[1:],
+                     bundle.dtype, root_rank=root_rank)
+    with _timeline.op_range(name or "broadcast", "BROADCAST"):
+        return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
 
 
 def grouped_broadcast(tensors: Sequence, root_rank: int, *,
@@ -520,7 +621,6 @@ def grouped_broadcast(tensors: Sequence, root_rank: int, *,
     :func:`grouped_allreduce`, the analog of the reference's fusion buffer)
     so ``broadcast_parameters`` over a large model dispatches O(dtypes)
     programs instead of O(leaves)."""
-    del name
     if not tensors:
         return []
     pset = _resolve(process_set)
@@ -540,9 +640,13 @@ def grouped_broadcast(tensors: Sequence, root_rank: int, *,
     root_pos = pset.ranks.index(root_rank)
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
-    fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
-                                     len(fused_inputs))
-    fused_outputs = fn(*fused_inputs)
+    _negotiate_eager_group("grouped_broadcast", REQ_BROADCAST, name,
+                           [(b.shape[1:], b.dtype) for b in bundles],
+                           root_rank=root_rank)
+    with _timeline.op_range(name or "grouped_broadcast", "GROUPED_BROADCAST"):
+        fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
+                                         len(fused_inputs))
+        fused_outputs = fn(*fused_inputs)
     return _split_fused(fused_outputs, metas, len(tensors))
 
 
@@ -552,7 +656,6 @@ def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
     ``operations.cc:1642-1727``). Equal splits only for now: rank *i*'s
     j-th chunk of ``size`` equal chunks goes to rank *j* (uneven ``splits``
     land with the dynamic engine)."""
-    del name
     if splits is not None:
         raise NotImplementedError(
             "uneven alltoall splits are not supported yet; pass tensors with "
@@ -571,7 +674,10 @@ def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
     if bundle.shape[1] % n != 0:
         raise ValueError(f"alltoall dim0 ({bundle.shape[1]}) must be divisible "
                          f"by process set size ({n})")
-    out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
+    _negotiate_eager("alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
+                     bundle.dtype)
+    with _timeline.op_range(name or "alltoall", "ALLTOALL"):
+        out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
     return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
 
 
@@ -579,7 +685,6 @@ def reducescatter(tensor, *, op: ReduceOp = ReduceOp.SUM,
                   process_set: ProcessSet | None = None,
                   name: str | None = None, axis_name=None):
     """Reduce-scatter along dim 0: each rank receives one reduced chunk."""
-    del name
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
     _check_op_dtype(op, jnp.result_type(tensor if not isinstance(tensor, PerRank)
@@ -598,7 +703,11 @@ def reducescatter(tensor, *, op: ReduceOp = ReduceOp.SUM,
     if bundle.shape[1] % n != 0:
         raise ValueError(f"reducescatter dim0 ({bundle.shape[1]}) must be "
                          f"divisible by process set size ({n})")
-    out = _eager_reducescatter_fn(pset.mesh(), axis, lowered_op, float(post))(bundle)
+    _negotiate_eager("reducescatter", REQ_REDUCESCATTER, name,
+                     bundle.shape[1:], bundle.dtype)
+    with _timeline.op_range(name or "reducescatter", "REDUCESCATTER"):
+        out = _eager_reducescatter_fn(pset.mesh(), axis, lowered_op,
+                                      float(post))(bundle)
     return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
 
 
@@ -610,6 +719,7 @@ def barrier(*, process_set: ProcessSet | None = None, axis_name=None):
     axis = _resolve_axis(axis_name)
     if _axis_is_bound(axis):
         return  # traced code is synchronous by construction
+    _negotiate_eager("barrier", REQ_BARRIER, None, (), jnp.int32)
     fn = _eager_allreduce_fn(pset.mesh(), axis, ReduceOp.SUM, 1.0, 1.0)
     jax.block_until_ready(fn(jnp.zeros((pset.size(), 1), jnp.int32)))
 

@@ -125,6 +125,16 @@ def init(
             "initialized: %d chips across %d processes (this=%d, local=%s)",
             len(devs), _state.process_count, proc_index, local_ranks,
         )
+    # Outside the lock: timeline autostart builds the native engine.
+    from . import timeline as _timeline
+    _timeline.maybe_autostart()
+    # Multi-process jobs start the negotiation service now (the analog of
+    # the reference spawning BackgroundThreadLoop inside init,
+    # operations.cc:811-864): every process must tick cycles even before
+    # its first collective, or peers' exchanges block and stalls go
+    # undetected.
+    from . import engine_service as _engine_service
+    _engine_service.get_service()
 
 
 def _distributed_client_active() -> bool:
@@ -178,8 +188,12 @@ def _maybe_distributed_init() -> None:
 
 def shutdown() -> None:
     """Tear down the runtime (reference ``horovod_shutdown``,
-    ``operations.cc:926-942``)."""
+    ``operations.cc:926-942``). Also stops the negotiation service — it is
+    bound to this world's size/rank/KV prefix and must be rebuilt by the
+    next init()."""
     global _state
+    from . import engine_service as _engine_service
+    _engine_service.reset_service()
     with _lock:
         _state = None
 

@@ -1,0 +1,130 @@
+"""Timeline: Chrome-trace recording of eager collectives.
+
+TPU-native rebuild of the reference Timeline (``timeline.cc:1-678``, writer
+thread + per-tensor lanes; runtime start/stop via ``horovod_start_timeline``
+at ``operations.cc:1032-1064``). The writer lives in the native engine
+(``native/timeline.cc``); this module owns the process-wide instance, the
+``HVD_TIMELINE`` auto-start (seeded by ``hvdrun --timeline-filename``), and
+the recording hooks the eager collectives call.
+
+Traced-mode collectives compile into the XLA program, where a wall-clock
+writer cannot see them — use ``jax.profiler`` traces for those; eager ops
+additionally get a ``jax.profiler.TraceAnnotation`` range so both timelines
+line up (the NVTX analog, ``nvtx_op_range.cc``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .utils import envs
+from .utils import logging as hvd_logging
+
+# Rank suffix appended per process so concurrent multi-process jobs don't
+# clobber one file (the reference writes coordinator-only; symmetric
+# processes each write their own view).
+_lock = threading.Lock()
+_engine = None  # NativeEngine owning the active timeline writer
+_active = False
+
+NEGOTIATE = "NEGOTIATE"
+PHASE_BEGIN = 0
+PHASE_END = 1
+PHASE_INSTANT = 2
+
+
+def _get_engine():
+    global _engine
+    if _engine is None:
+        from .dynamic import NativeEngine
+        _engine = NativeEngine(world_size=1, rank=0)
+    return _engine
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start recording eager collectives to ``file_path`` (Chrome trace
+    JSON; open in ``chrome://tracing`` / Perfetto). Reference
+    ``hvd.start_timeline`` → ``horovod_start_timeline``
+    (``operations.cc:1032-1064``)."""
+    global _active
+    del mark_cycles  # cycle marks need the dynamic service; accepted for parity
+    with _lock:
+        _get_engine().timeline_start(file_path)
+        _active = True
+    import atexit
+    atexit.register(stop_timeline)  # idempotent; flushes on interpreter exit
+
+
+def stop_timeline() -> None:
+    """Flush and close the timeline (reference ``hvd.stop_timeline``)."""
+    global _active
+    with _lock:
+        if _engine is not None:
+            _engine.timeline_stop()
+        _active = False
+
+
+def timeline_active() -> bool:
+    return _active
+
+
+def maybe_autostart() -> None:
+    """Start the timeline when ``HVD_TIMELINE`` is seeded (by
+    ``hvdrun --timeline-filename`` or the user). Called from
+    ``hvd.init()``. ``DYNAMIC`` defers to an explicit
+    :func:`start_timeline` call, like the reference
+    (``operations.cc:466-488``)."""
+    path = envs.get(envs.TIMELINE)
+    if not path or path.upper() == "DYNAMIC" or _active:
+        return
+    from . import runtime
+    if runtime.process_count() > 1:
+        path = f"{path}.{runtime.process_rank()}"
+    try:
+        start_timeline(path)
+    except Exception as e:  # IO error / native engine unavailable: a
+        # missing timeline must never break init
+        hvd_logging.error("cannot start timeline at %s: %s", path, e)
+
+
+def record(tensor: str, activity: str, phase: int) -> None:
+    """Record one event when the timeline is active (cheap no-op guard on
+    the hot path)."""
+    if not _active:
+        return
+    eng = _engine
+    if eng is not None:
+        eng.timeline_record(tensor, activity, phase)
+
+
+class op_range:
+    """Context manager tracing one eager collective: begin/end records in
+    the Chrome timeline plus a ``jax.profiler.TraceAnnotation`` range so
+    the op also shows in XLA profiler traces (NVTX analog)."""
+
+    __slots__ = ("tensor", "activity", "_ann")
+
+    def __init__(self, tensor: str, activity: str):
+        self.tensor = tensor
+        self.activity = activity
+        self._ann = None
+
+    def __enter__(self):
+        if _active:
+            record(self.tensor, self.activity, PHASE_BEGIN)
+            try:
+                import jax.profiler
+                self._ann = jax.profiler.TraceAnnotation(
+                    f"hvd.{self.activity}.{self.tensor}")
+                self._ann.__enter__()
+            except Exception:  # profiler unavailable: timeline still works
+                self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        if _active:
+            record(self.tensor, self.activity, PHASE_END)
+        return False

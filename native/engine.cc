@@ -255,6 +255,16 @@ class Engine {
         join_pending_ = true;
         continue;
       }
+      /* Cache invalidation must be driven by the globally-ingested request
+       * stream, not by this rank's local inflight set: every rank ingests
+       * the identical rank-ordered lists, so erases happen on the same
+       * cycle everywhere and the lazily-recomputed bit positions stay
+       * aligned (the reference syncs invalid bits across workers for the
+       * same reason, response_cache.h:149-151 CacheCoordinator). */
+      if (q.type != RequestType::BARRIER &&
+          cache_.cached(q) == ResponseCache::State::INVALID) {
+        cache_.erase(q.name);
+      }
       auto it = table_.find(q.name);
       if (it == table_.end()) {
         TableEntry e;
@@ -297,11 +307,10 @@ class Engine {
     std::vector<std::string> served;
     for (auto& kv : local_inflight_) {
       const Request& q = kv.second;
+      /* INVALID entries were already erased during ingest() — driven by
+       * the global request stream so every rank erased identically; a
+       * local-only erase here would desynchronize bit positions. */
       auto state = cache_.cached(q);
-      if (state == ResponseCache::State::INVALID) {
-        cache_.erase(q.name);
-        continue;
-      }
       if (state != ResponseCache::State::HIT) continue;
       int32_t bit = cache_.bit_of(q.name);
       bool global_hit = bit >= 0 &&
@@ -320,6 +329,11 @@ class Engine {
     for (const auto& name : served) {
       cache_.touch(name);
       complete(name);
+      /* A cache-served tensor must not also be scheduled from the
+       * negotiation table (its requests were ingested this cycle like
+       * everyone else's). The served set is identical on every rank (AND
+       * of identical bit layouts), so table erases stay consistent. */
+      table_.erase(name);
     }
     return 0;
   }

@@ -1,6 +1,7 @@
 #include "timeline.h"
 
 #include <chrono>
+#include <cstdio>
 
 namespace hvd {
 namespace {
@@ -85,14 +86,16 @@ void Timeline::writer_loop() {
 }
 
 void Timeline::write_record(const Record& r) {
-  // Called with mu_ held (writer thread only).
+  // Called with mu_ held (writer thread only). Resolve the lane first: a
+  // new tensor emits its thread_name metadata record, which must be a
+  // complete record of its own, not spliced into the middle of this one.
+  int64_t lane = lane_of(r.tensor);
   const char* ph = r.phase == 0 ? "B" : (r.phase == 1 ? "E" : "i");
   if (!first_event_) out_ << ",\n";
   first_event_ = false;
   out_ << "{\"name\": \"" << json_escape(r.activity) << "\", \"cat\": \""
        << json_escape(r.tensor) << "\", \"ph\": \"" << ph
-       << "\", \"ts\": " << r.ts_us << ", \"pid\": 0, \"tid\": "
-       << lane_of(r.tensor);
+       << "\", \"ts\": " << r.ts_us << ", \"pid\": 0, \"tid\": " << lane;
   if (r.phase == 2) out_ << ", \"s\": \"t\"";
   out_ << "}";
 }

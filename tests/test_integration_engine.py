@@ -1,0 +1,112 @@
+"""Multi-process dynamic engine integration: real 2-process hvdrun jobs
+negotiating eager collectives over the launcher KV (the analog of the
+reference's mpirun-driven parallel tests)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu import _native
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native engine unavailable")
+
+_PRELUDE = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+try: jax.config.update("jax_platforms", "cpu")
+except Exception: pass
+import jax.numpy as jnp
+import horovod_tpu as hvd
+hvd.init()
+rank = int(os.environ["HVD_RANK"])
+"""
+
+
+def _run(tmp_path, body, np=2, timeout=300, extra_env=None):
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent(body))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", str(np),
+         "--", sys.executable, str(worker)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout)
+
+
+class TestNegotiatedCollectives:
+    def test_matching_metadata_succeeds(self, tmp_path):
+        proc = _run(tmp_path, """
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="grads")
+        assert out.shape == (4,)
+        out2 = hvd.allreduce(jnp.ones(3), op=hvd.Sum)  # auto-named
+        print("WORKER_OK", rank, flush=True)
+        """)
+        assert proc.returncode == 0, proc.stdout
+        assert proc.stdout.count("WORKER_OK") == 2
+
+    def test_shape_mismatch_raises_informative_error(self, tmp_path):
+        proc = _run(tmp_path, """
+        from horovod_tpu.dynamic import HorovodCollectiveError
+        shape = 4 if rank == 0 else 5
+        try:
+            hvd.allreduce(jnp.ones(shape), op=hvd.Sum, name="bad")
+            print("NO_ERROR", rank, flush=True)
+        except HorovodCollectiveError as e:
+            assert "Mismatched ALLREDUCE tensor shapes" in str(e), str(e)
+            assert "[4]" in str(e) and "[5]" in str(e), str(e)
+            print("GOT_MISMATCH_ERROR", rank, flush=True)
+        """)
+        assert proc.stdout.count("GOT_MISMATCH_ERROR") == 2, proc.stdout
+        assert "NO_ERROR" not in proc.stdout
+
+    def test_op_mismatch_raises(self, tmp_path):
+        proc = _run(tmp_path, """
+        from horovod_tpu.dynamic import HorovodCollectiveError
+        try:
+            if rank == 0:
+                hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="op_clash")
+            else:
+                hvd.allgather(jnp.ones(4), name="op_clash")
+            print("NO_ERROR", rank, flush=True)
+        except HorovodCollectiveError as e:
+            assert "Mismatched collective operations" in str(e), str(e)
+            print("GOT_OP_ERROR", rank, flush=True)
+        """)
+        assert proc.stdout.count("GOT_OP_ERROR") == 2, proc.stdout
+
+    def test_stall_warning_logged(self, tmp_path):
+        proc = _run(tmp_path, """
+        import time
+        from horovod_tpu.dynamic import HorovodCollectiveError
+        if rank == 0:
+            try:
+                hvd.allreduce(jnp.ones(2), op=hvd.Sum, name="lonely",
+                              )
+            except HorovodCollectiveError as e:
+                print("TIMED_OUT", rank, flush=True)
+        else:
+            time.sleep(8)  # never submits "lonely"
+            print("SAT_OUT", rank, flush=True)
+        """, extra_env={"HVD_STALL_CHECK_TIME_SECONDS": "1",
+                        "HVD_ELASTIC_TIMEOUT": "6"})
+        assert "TIMED_OUT" in proc.stdout, proc.stdout
+        assert "SAT_OUT" in proc.stdout
+        assert "not ready on all processes" in proc.stdout, proc.stdout
+
+    def test_engine_disabled_by_knob(self, tmp_path):
+        proc = _run(tmp_path, """
+        from horovod_tpu import engine_service
+        assert engine_service.get_service() is None
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum)
+        print("WORKER_OK", rank, flush=True)
+        """, extra_env={"HVD_DYNAMIC_ENGINE": "0"})
+        assert proc.returncode == 0, proc.stdout
+        assert proc.stdout.count("WORKER_OK") == 2
