@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# CI gate: the checks a snapshot must pass before it ships.
+#
+# Mirrors the reference's pipeline structure (.buildkite/gen-pipeline.sh:
+# unit suite + parallel multi-process jobs + example smoke runs), adapted to
+# the TPU-native rebuild: everything runs on a virtual 8-device CPU mesh so
+# no cluster (and no TPU) is required.
+#
+# Usage: ./ci.sh            # full gate
+#        ./ci.sh --fast     # suite only (skip artifacts + examples)
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+fail=0
+
+step() { echo; echo "=== $* ==="; }
+
+step "1/4 test suite (tests/, virtual 8-device mesh via conftest)"
+python -m pytest tests/ -q -x
+
+if [[ "${1:-}" == "--fast" ]]; then
+  echo "--fast: skipping artifact + example checks"
+  exit 0
+fi
+
+step "2/4 driver artifact: single-chip compile check (entry)"
+python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn).lower(*args).compile()
+print("entry() compile OK")
+EOF
+
+step "3/4 driver artifact: multi-chip dryrun (8 virtual devices)"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+step "4/4 example smoke runs (np=2, like gen-pipeline.sh:160-290)"
+if [ -d examples ]; then
+  for ex in examples/*.py; do
+    echo "--- $ex"
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python "$ex" --smoke || fail=1
+  done
+else
+  echo "(no examples/ yet)"
+fi
+
+exit $fail

@@ -88,6 +88,8 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, u8p, ctypes.c_size_t]
     lib.hvd_engine_register_group.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    lib.hvd_engine_abandon.restype = ctypes.c_int32
+    lib.hvd_engine_abandon.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.hvd_engine_pending_count.restype = ctypes.c_int32
     lib.hvd_engine_pending_count.argtypes = [ctypes.c_void_p]
     lib.hvd_engine_cache_size.restype = ctypes.c_int32

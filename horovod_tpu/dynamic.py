@@ -224,7 +224,12 @@ class NativeEngine:
         rc = self._lib.hvd_engine_enqueue(
             self._h, name.encode(), request_type, dtype, element_size,
             arr, len(shape), root_rank, group_id)
-        if rc == -1:
+        if rc == -2:
+            raise DuplicateNameError(
+                f"tensor name {name!r} is still in flight from a timed-out "
+                "negotiation with different type/dtype/shape/root metadata; "
+                "a retry must match the original request (or use a new name)")
+        if rc < 0:
             raise DuplicateNameError(
                 f"tensor name {name!r} was enqueued while a request with "
                 "the same name is still pending; pass a unique name= "
@@ -269,6 +274,11 @@ class NativeEngine:
 
     def register_group(self, group_id: int, n_members: int) -> None:
         self._lib.hvd_engine_register_group(self._h, group_id, n_members)
+
+    def abandon(self, name: str) -> bool:
+        """Drop a locally-submitted request (post-timeout retry path).
+        Returns True if the name was outstanding."""
+        return self._lib.hvd_engine_abandon(self._h, name.encode()) == 0
 
     # -- introspection -----------------------------------------------------
 

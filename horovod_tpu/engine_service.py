@@ -147,23 +147,37 @@ class DynamicService:
                 pend = _Pending()
                 self._pending[req["name"]] = pend
                 pends.append(pend)
-                self.engine.enqueue(
-                    req["name"], req["request_type"],
-                    dtype=req.get("dtype", 0),
-                    element_size=req.get("element_size", 4),
-                    shape=req.get("shape", ()),
-                    root_rank=req.get("root_rank", -1),
-                    group_id=req.get("group_id", -1))
+                try:
+                    self.engine.enqueue(
+                        req["name"], req["request_type"],
+                        dtype=req.get("dtype", 0),
+                        element_size=req.get("element_size", 4),
+                        shape=req.get("shape", ()),
+                        root_rank=req.get("root_rank", -1),
+                        group_id=req.get("group_id", -1))
+                except Exception:
+                    # Roll back this batch's already-enqueued members so a
+                    # mid-batch failure doesn't poison their names forever.
+                    # The failing member itself was NOT enqueued — only drop
+                    # its _pending entry (abandoning it would cancel the
+                    # older in-flight request that made it a duplicate).
+                    self._pending.pop(req["name"], None)
+                    for done in requests[:len(pends) - 1]:
+                        self._pending.pop(done["name"], None)
+                        self.engine.abandon(done["name"])
+                    raise
         for req in requests:
             _timeline.record(req["name"], _timeline.NEGOTIATE,
                              _timeline.PHASE_BEGIN)
         deadline = (timeout if timeout is not None
                     else self._exchange_timeout)
         end = time.monotonic() + deadline
+        timed_out = False
         try:
             for req, pend in zip(requests, pends):
                 remaining = end - time.monotonic()
                 if remaining <= 0 or not pend.event.wait(remaining):
+                    timed_out = True
                     raise HorovodCollectiveError(
                         f"negotiation of {req['name']!r} timed out after "
                         f"{deadline}s (some processes never submitted it; "
@@ -173,8 +187,14 @@ class DynamicService:
                 _timeline.record(req["name"], _timeline.NEGOTIATE,
                                  _timeline.PHASE_END)
             with self._mu:
-                for req in requests:
+                for req, pend in zip(requests, pends):
                     self._pending.pop(req["name"], None)
+                    # On timeout, also abandon undelivered members in the
+                    # native engine so the name can be retried (otherwise
+                    # it sits in outstanding_ forever and any reuse raises
+                    # DuplicateNameError with no recovery path).
+                    if timed_out and pend.response is None:
+                        self.engine.abandon(req["name"])
         out = []
         for req, pend in zip(requests, pends):
             resp = pend.response

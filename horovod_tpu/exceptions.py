@@ -69,7 +69,6 @@ _TRANSIENT_DISTRIBUTED_MARKERS = (
 # "unavailable" or "peer" would swallow ordinary user errors.
 _STRICT_DISTRIBUTED_MARKERS = (
     "coordination service",
-    "deadline_exceeded",
     "jax.distributed",
     "distributed runtime",
     "preemption sync",

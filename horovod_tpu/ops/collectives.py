@@ -481,7 +481,7 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
     lowered_op, post = handle_average(op, pset.size(), postscale_factor)
     bundle, _ = _as_bundle(tensor, pset)
     _negotiate_eager("allreduce", REQ_ALLREDUCE, name, bundle.shape[1:],
-                     bundle.dtype)
+                     bundle.dtype, pset)
     with _timeline.op_range(name or "allreduce", "ALLREDUCE"):
         if (lowered_op == ReduceOp.SUM
                 and hierarchical.hierarchical_enabled_for(pset)):
@@ -535,7 +535,7 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
     _negotiate_eager_group("grouped_allreduce", REQ_ALLREDUCE, name,
-                           [(b.shape[1:], b.dtype) for b in bundles])
+                           [(b.shape[1:], b.dtype) for b in bundles], pset)
     with _timeline.op_range(name or "grouped_allreduce", "GROUPED_ALLREDUCE"):
         if (lowered_op == ReduceOp.SUM
                 and hierarchical.hierarchical_enabled_for(pset)):
@@ -573,7 +573,7 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
             "so the op can lower to an XLA collective.")
     bundle, _ = _as_bundle(tensor, pset)
     _negotiate_eager("allgather", REQ_ALLGATHER, name, bundle.shape[1:],
-                     bundle.dtype)
+                     bundle.dtype, pset)
     with _timeline.op_range(name or "allgather", "ALLGATHER"):
         if hierarchical.hierarchical_allgather_enabled_for(pset):
             # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
@@ -608,7 +608,7 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
     bundle, _ = _as_bundle(tensor, pset)
     root_pos = pset.ranks.index(root_rank)
     _negotiate_eager("broadcast", REQ_BROADCAST, name, bundle.shape[1:],
-                     bundle.dtype, root_rank=root_rank)
+                     bundle.dtype, pset, root_rank=root_rank)
     with _timeline.op_range(name or "broadcast", "BROADCAST"):
         return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
 
@@ -641,7 +641,7 @@ def grouped_broadcast(tensors: Sequence, root_rank: int, *,
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
     _negotiate_eager_group("grouped_broadcast", REQ_BROADCAST, name,
-                           [(b.shape[1:], b.dtype) for b in bundles],
+                           [(b.shape[1:], b.dtype) for b in bundles], pset,
                            root_rank=root_rank)
     with _timeline.op_range(name or "grouped_broadcast", "GROUPED_BROADCAST"):
         fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
@@ -675,7 +675,7 @@ def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
         raise ValueError(f"alltoall dim0 ({bundle.shape[1]}) must be divisible "
                          f"by process set size ({n})")
     _negotiate_eager("alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
-                     bundle.dtype)
+                     bundle.dtype, pset)
     with _timeline.op_range(name or "alltoall", "ALLTOALL"):
         out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
     return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
@@ -704,7 +704,7 @@ def reducescatter(tensor, *, op: ReduceOp = ReduceOp.SUM,
         raise ValueError(f"reducescatter dim0 ({bundle.shape[1]}) must be "
                          f"divisible by process set size ({n})")
     _negotiate_eager("reducescatter", REQ_REDUCESCATTER, name,
-                     bundle.shape[1:], bundle.dtype)
+                     bundle.shape[1:], bundle.dtype, pset)
     with _timeline.op_range(name or "reducescatter", "REDUCESCATTER"):
         out = _eager_reducescatter_fn(pset.mesh(), axis, lowered_op,
                                       float(post))(bundle)
@@ -719,7 +719,7 @@ def barrier(*, process_set: ProcessSet | None = None, axis_name=None):
     axis = _resolve_axis(axis_name)
     if _axis_is_bound(axis):
         return  # traced code is synchronous by construction
-    _negotiate_eager("barrier", REQ_BARRIER, None, (), jnp.int32)
+    _negotiate_eager("barrier", REQ_BARRIER, None, (), jnp.int32, pset)
     fn = _eager_allreduce_fn(pset.mesh(), axis, ReduceOp.SUM, 1.0, 1.0)
     jax.block_until_ready(fn(jnp.zeros((pset.size(), 1), jnp.int32)))
 

@@ -26,6 +26,7 @@ from .utils import logging as hvd_logging
 _lock = threading.Lock()
 _engine = None  # NativeEngine owning the active timeline writer
 _active = False
+_atexit_registered = False
 
 NEGOTIATE = "NEGOTIATE"
 PHASE_BEGIN = 0
@@ -46,13 +47,15 @@ def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     JSON; open in ``chrome://tracing`` / Perfetto). Reference
     ``hvd.start_timeline`` → ``horovod_start_timeline``
     (``operations.cc:1032-1064``)."""
-    global _active
+    global _active, _atexit_registered
     del mark_cycles  # cycle marks need the dynamic service; accepted for parity
     with _lock:
         _get_engine().timeline_start(file_path)
         _active = True
-    import atexit
-    atexit.register(stop_timeline)  # idempotent; flushes on interpreter exit
+        if not _atexit_registered:
+            import atexit
+            atexit.register(stop_timeline)  # flushes on interpreter exit
+            _atexit_registered = True
 
 
 def stop_timeline() -> None:

@@ -216,6 +216,25 @@ class Engine {
     q.group_id = group_id;
     q.name = std::move(key);
     q.shape.assign(shape, shape + ndim);
+    /* Retry after abandon(): if this rank's original submission is still
+     * being negotiated globally (table entry with our rank ready), do NOT
+     * emit a second wire request — every rank would grow a ghost table
+     * entry no one else ever joins. Re-attach instead: the in-flight
+     * negotiation completes this name normally. The retry must carry the
+     * same metadata as the in-flight request — re-attaching never passes
+     * through ingest()'s validate(), so a silent mismatch would defeat the
+     * negotiation layer's core guarantee. */
+    auto it = table_.find(q.name);
+    if (it != table_.end() && it->second.ready_ranks.count(rank_)) {
+      const Request& orig = it->second.first;
+      if (q.type != orig.type || q.dtype != orig.dtype ||
+          q.shape != orig.shape || q.root_rank != orig.root_rank) {
+        return -2;  // metadata differs from the in-flight negotiation
+      }
+      outstanding_.insert(q.name);
+      local_inflight_[q.name] = std::move(q);
+      return 1;  // re-attached to in-flight negotiation
+    }
     outstanding_.insert(q.name);
     pending_.push_back(std::move(q));
     return 0;
@@ -464,6 +483,18 @@ class Engine {
     group_member_counts_[group_id] = n_members;
   }
 
+  /* Abandon a locally-submitted request after a negotiation timeout so the
+   * name can be retried (the reference has no analog: its waits are
+   * unbounded). Clears local bookkeeping only; the shared table entry (if
+   * the request already went out) completes or stalls globally. */
+  int32_t abandon(const char* name) {
+    std::lock_guard<std::mutex> lock(mu_);
+    std::string key(name);
+    if (!outstanding_.count(key)) return -1;
+    complete(key);
+    return 0;
+  }
+
   int32_t pending_count() {
     std::lock_guard<std::mutex> lock(mu_);
     return static_cast<int32_t>(pending_.size() + local_inflight_.size());
@@ -609,6 +640,16 @@ class Engine {
   void complete(const std::string& name) {
     local_inflight_.erase(name);
     outstanding_.erase(name);
+    /* A completed op must not leave a same-named request queued for the
+     * next pop (possible when a post-timeout retry was enqueued just
+     * before a straggler completed the original): that request would
+     * become a ghost table entry on every rank. */
+    for (auto it = pending_.begin(); it != pending_.end(); ++it) {
+      if (it->name == name) {
+        pending_.erase(it);
+        break;
+      }
+    }
   }
 
   int32_t world_size_;
@@ -697,6 +738,10 @@ void hvd_engine_register_group(hvd_engine_t engine, int32_t group_id,
                                int32_t n_members) {
   static_cast<hvd::Engine*>(engine)->register_group(
       group_id, static_cast<size_t>(n_members));
+}
+
+int32_t hvd_engine_abandon(hvd_engine_t engine, const char* name) {
+  return static_cast<hvd::Engine*>(engine)->abandon(name);
 }
 
 int32_t hvd_timeline_start(hvd_engine_t engine, const char* path) {

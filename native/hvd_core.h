@@ -73,7 +73,10 @@ void hvd_engine_destroy(hvd_engine_t engine);
  * caller (only equality matters for mismatch checks / fusion classes);
  * element_size is bytes per element for fusion accounting. root_rank is
  * used by BROADCAST, group_id groups tensors for joint fusion (-1 = none).
- * Returns 0, or -1 on duplicate name still pending (common.h:229-232). */
+ * Returns 0 (queued), 1 (re-attached to this rank's still-in-flight
+ * negotiation after an abandon — no new wire request is emitted), -1 on
+ * duplicate name still pending (common.h:229-232), or -2 when a
+ * post-abandon retry's metadata differs from the in-flight negotiation. */
 int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
                            int32_t request_type, int32_t dtype,
                            int32_t element_size, const int64_t* shape,
@@ -111,6 +114,11 @@ int32_t hvd_engine_cache_bits(hvd_engine_t engine, const uint8_t** out,
  * survived are moved into the response plan without full negotiation. */
 int32_t hvd_engine_commit_cache_bits(hvd_engine_t engine, const uint8_t* bits,
                                      size_t len);
+
+/* Abandon a locally-submitted request (e.g. after a negotiation timeout)
+ * so its name can be enqueued again. Returns 0, or -1 if the name is not
+ * outstanding. */
+int32_t hvd_engine_abandon(hvd_engine_t engine, const char* name);
 
 /* stall inspector -------------------------------------------------------- */
 

@@ -405,6 +405,61 @@ class TestTimeline:
             e.close()
 
 
+class TestAbandon:
+    """Post-timeout retry path: abandon() clears local bookkeeping so a
+    name can be enqueued again (the reference has no analog — its waits
+    are unbounded)."""
+
+    def test_abandon_before_send_allows_retry(self, world2):
+        a, _ = world2
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+        assert a.abandon("t")
+        assert not a.abandon("t")  # not outstanding anymore
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))  # must not raise
+
+    def test_abandon_unsent_request_never_hits_the_wire(self):
+        engines = make_world(2, stall_warn=0.05)
+        try:
+            a, b = engines
+            a.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+            a.abandon("t")
+            plans = drive_cycle(engines)
+            assert plans == [[], []]
+            # past the (tiny) stall-warn threshold a ghost table entry on
+            # the other rank would show up in its stall report
+            time.sleep(0.1)
+            report, _ = b.stall_report()
+            assert report == []
+        finally:
+            close_world(engines)
+
+    def test_retry_with_different_metadata_rejected(self, world2):
+        a, _ = world2
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+        drive_cycle(world2)  # request went out; table entry live
+        assert a.abandon("t")
+        with pytest.raises(DuplicateNameError, match="different"):
+            a.enqueue("t", REQ_ALLREDUCE, shape=(8,))
+        # matching retry still fine
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+
+    def test_retry_after_sent_reattaches_no_ghost(self, world2):
+        a, b = world2
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+        drive_cycle(world2)  # a's request goes out; b hasn't submitted
+        assert a.abandon("t")
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))  # re-attach, no new wire req
+        assert a.pop_requests() == b.pop_requests()  # both serialize empty
+        b.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+        plans = drive_cycle(world2)
+        assert [p[0].tensor_names for p in plans] == [["t"], ["t"]]
+        # fully complete everywhere: name reusable, nothing stalled
+        a.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+        b.enqueue("t", REQ_ALLREDUCE, shape=(4,))
+        plans = drive_cycle(world2)
+        assert [p[0].tensor_names for p in plans] == [["t"], ["t"]]
+
+
 class TestBitvectorAnd:
     def test_and(self):
         assert and_bitvectors([b"\xff\x0f", b"\xf0\xff"]) == b"\xf0\x0f"
