@@ -49,6 +49,7 @@ from .ops import (
     PerRank,
     Product,
     ReduceOp,
+    SparseRows,
     Sum,
     adasum_allreduce,
     allgather,
@@ -71,6 +72,10 @@ from .ops import (
     per_rank,
     poll,
     reducescatter,
+    rows_from_dense,
+    rows_to_dense,
+    sparse_allreduce,
+    sparse_allreduce_to_dense,
     synchronize,
 )
 from .process_sets import (
@@ -92,6 +97,7 @@ from .functions import (
 )
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from .timeline import start_timeline, stop_timeline
+from . import autotune
 from . import elastic
 from .version import __version__
 
@@ -112,9 +118,11 @@ __all__ = [
     "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce", "grouped_broadcast",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
+    "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce",
+    "sparse_allreduce_to_dense",
     "ProcessSet", "add_process_set", "global_process_set", "remove_process_set",
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
-    "start_timeline", "stop_timeline", "elastic", "__version__",
+    "start_timeline", "stop_timeline", "autotune", "elastic", "__version__",
 ]

@@ -1,0 +1,325 @@
+"""Autotuner: runtime search over performance knobs.
+
+TPU-native rebuild of the reference's ``ParameterManager``
+(``/root/reference/horovod/common/parameter_manager.cc:1-528``, header
+``parameter_manager.h:42-110``): while training runs, score each candidate
+knob configuration by observed collective throughput (bytes/sec), explore
+the space, and settle on the best configuration. The reference drives the
+exploration with Bayesian optimization over a Gaussian-process posterior
+(``optim/bayesian_optimization.cc:1-194``); here a cyclic coordinate search
+over small discrete grids is used — the knob space is tiny (three knobs,
+<= 8 values each) and coordinate descent converges in a handful of samples
+without the GP machinery.
+
+Tuned knobs (the subset of the reference's set that has a consumer in the
+TPU rebuild; ``operations.cc:584-594``):
+
+* ``FUSION_THRESHOLD`` — eager fusion bucket size in bytes: how much of a
+  grouped op's payload is packed into one wire buffer / one compiled
+  program (consumer: ``ops/collectives._fuse_by_dtype``).
+* ``CYCLE_TIME`` — dynamic-engine negotiation cycle in ms (consumer:
+  ``engine_service.DynamicService``; re-read every cycle).
+* ``HIERARCHICAL_ALLREDUCE`` — flat vs two-level ICI/DCN schedule
+  (consumer: ``ops/hierarchical.hierarchical_enabled_for``).
+
+Knobs pinned via the environment are **fixed** and excluded from tuning,
+exactly like the reference (env-set params are marked untunable,
+``operations.cc:490-523``). Discipline follows the reference: the first
+``HVD_AUTOTUNE_WARMUP_SAMPLES`` samples are discarded (jit warmup), each
+sample scores ``HVD_AUTOTUNE_STEPS_PER_SAMPLE`` recorded collectives, and
+exploration stops after ``HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES`` samples or
+when a full coordinate pass yields no improvement. ``HVD_AUTOTUNE_LOG``
+writes one CSV row per sample (``parameter_manager.h:48,111-113``).
+
+Multi-process jobs must apply identical knob values everywhere — the eager
+collectives are SPMD programs over all processes, so a per-process choice
+of e.g. hierarchical-vs-flat would deadlock. Rank 0 therefore aggregates
+scores and decides; decisions travel over the launcher KV store (the
+analog of ``Controller::SynchronizeParameters``, ``controller.h:70``).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import threading
+import time
+
+from .utils import envs
+from .utils import logging as hvd_logging
+
+KB = 1024
+MB = 1024 * 1024
+
+DEFAULT_WARMUP_SAMPLES = 3       # parameter_manager.h:42-110
+DEFAULT_STEPS_PER_SAMPLE = 10
+DEFAULT_MAX_SAMPLES = 40
+
+
+class Tunable:
+    """One knob: a discrete candidate grid plus an applier."""
+
+    def __init__(self, knob: str, candidates, apply_fn=None):
+        self.knob = knob
+        self.candidates = list(candidates)
+        self.apply_fn = apply_fn
+        self.fixed = envs.is_env_fixed(knob)
+        self.index = 0
+
+    @property
+    def value(self):
+        return self.candidates[self.index]
+
+    def apply(self):
+        envs.set_override(self.knob, self.value)
+        if self.apply_fn is not None:
+            self.apply_fn(self.value)
+
+
+def _default_tunables() -> list[Tunable]:
+    return [
+        Tunable(envs.FUSION_THRESHOLD,
+                [1 * MB, 4 * MB, 16 * MB, 64 * MB, 128 * MB, 256 * MB]),
+        Tunable(envs.CYCLE_TIME, [1.0, 2.5, 5.0, 10.0, 20.0, 40.0]),
+        Tunable(envs.HIERARCHICAL_ALLREDUCE, [0, 1]),
+    ]
+
+
+class ParameterManager:
+    """Samples bytes/sec and coordinate-searches the knob grid."""
+
+    def __init__(self, tunables: list[Tunable] | None = None, *,
+                 warmup_samples: int | None = None,
+                 steps_per_sample: int | None = None,
+                 max_samples: int | None = None,
+                 log_path: str | None = None,
+                 sync=None):
+        self.tunables = tunables if tunables is not None else _default_tunables()
+        self.warmup_samples = (warmup_samples if warmup_samples is not None
+                               else envs.get_int(envs.AUTOTUNE_WARMUP_SAMPLES,
+                                                 DEFAULT_WARMUP_SAMPLES))
+        self.steps_per_sample = (steps_per_sample if steps_per_sample is not None
+                                 else envs.get_int(envs.AUTOTUNE_STEPS_PER_SAMPLE,
+                                                   DEFAULT_STEPS_PER_SAMPLE))
+        self.max_samples = (max_samples if max_samples is not None
+                            else envs.get_int(envs.AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
+                                              DEFAULT_MAX_SAMPLES))
+        self.log_path = (log_path if log_path is not None
+                         else envs.get(envs.AUTOTUNE_LOG))
+        self._sync = sync  # rank-0 decision broadcast; see _synced_decision
+        self._mu = threading.Lock()
+        self._bytes = 0
+        self._steps = 0
+        self._sample_start = time.monotonic()
+        self._sample_idx = 0
+        self._active = [t for t in self.tunables if not t.fixed
+                        and len(t.candidates) > 1]
+        self._coord = 0          # which tunable is being swept
+        self._cand = 0           # candidate index under trial
+        self._best_score = None
+        self._best_state = [t.index for t in self.tunables]
+        self._pass_improved = False
+        self.converged = not self._active
+        self._log_writer = None
+        if self.log_path:
+            f = open(self.log_path, "w", newline="")
+            self._log_writer = csv.writer(f)
+            self._log_writer.writerow(
+                ["sample", "score_bytes_per_sec", "warmup", "converged"]
+                + [t.knob for t in self.tunables])
+            self._log_file = f
+        for t in self.tunables:
+            t.apply()
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, nbytes: int) -> None:
+        """Account one eager collective's wire payload; sample boundaries
+        land every ``steps_per_sample`` records. Cheap: one lock, two adds."""
+        if self.converged:
+            return
+        with self._mu:
+            self._bytes += int(nbytes)
+            self._steps += 1
+            if self._steps < self.steps_per_sample:
+                return
+            elapsed = time.monotonic() - self._sample_start
+            score = self._bytes / max(elapsed, 1e-9)
+            self._bytes = 0
+            self._steps = 0
+            self._end_sample(score)
+            self._sample_start = time.monotonic()
+
+    # -- search ------------------------------------------------------------
+
+    def _state(self) -> list[int]:
+        return [t.index for t in self.tunables]
+
+    def _apply_state(self, state: list[int]) -> None:
+        for t, i in zip(self.tunables, state):
+            t.index = i
+            t.apply()
+
+    def _end_sample(self, score: float) -> None:
+        warmup = self._sample_idx < self.warmup_samples
+        self._log(score, warmup)
+        self._sample_idx += 1
+        if warmup:
+            return
+        decision = self._synced_decision(score)
+        self._apply_state(decision["state"])
+        if decision["converged"]:
+            self._finish(decision["state"])
+
+    def _local_decision(self, score: float) -> dict:
+        """Advance the coordinate search by one scored sample."""
+        if self._best_score is None or score > self._best_score:
+            self._best_score = score
+            self._best_state = self._state()
+            self._pass_improved = True
+        if self._sample_idx - self.warmup_samples >= self.max_samples:
+            return {"state": self._best_state, "converged": True}
+        # move to the next candidate of the current coordinate, or the next
+        # coordinate (restarting from the best state found so far)
+        tun = self._active[self._coord]
+        self._cand += 1
+        if self._cand >= len(tun.candidates):
+            self._cand = 0
+            self._coord += 1
+            if self._coord >= len(self._active):
+                # full pass done
+                if not self._pass_improved:
+                    return {"state": self._best_state, "converged": True}
+                self._pass_improved = False
+                self._coord = 0
+        next_state = list(self._best_state)
+        active_tun = self._active[self._coord]
+        pos = self.tunables.index(active_tun)
+        next_state[pos] = self._cand
+        return {"state": next_state, "converged": False}
+
+    def _synced_decision(self, score: float) -> dict:
+        """Single process: decide locally. Multi-process: rank 0 averages
+        everyone's score for the sample and broadcasts the decision
+        (``Controller::SynchronizeParameters`` analog)."""
+        if self._sync is None:
+            return self._local_decision(score)
+        return self._sync(self._sample_idx, score, self._local_decision)
+
+    def _finish(self, state: list[int]) -> None:
+        self.converged = True
+        self._apply_state(state)
+        hvd_logging.info(
+            "autotune converged after %d samples: %s (score %.3g B/s)",
+            self._sample_idx,
+            {t.knob: t.value for t in self.tunables}, self._best_score or 0)
+        self._log(self._best_score or 0.0, False)
+        if self._log_writer:
+            self._log_file.close()
+            self._log_writer = None
+
+    def _log(self, score: float, warmup: bool) -> None:
+        if not self._log_writer:
+            return
+        self._log_writer.writerow(
+            [self._sample_idx, f"{score:.1f}", int(warmup), int(self.converged)]
+            + [t.value for t in self.tunables])
+        self._log_file.flush()
+
+    def current_config(self) -> dict:
+        return {t.knob: t.value for t in self.tunables}
+
+
+class KVScoreSync:
+    """Rank-0 decide + broadcast over the launcher KV store."""
+
+    def __init__(self, kv, world_size: int, rank: int,
+                 prefix: str = "autotune", timeout: float = 600.0):
+        self.kv = kv
+        self.world_size = world_size
+        self.rank = rank
+        self.prefix = prefix
+        self.timeout = timeout
+
+    def __call__(self, sample_idx: int, score: float, local_decision) -> dict:
+        self.kv.put(f"{self.prefix}/score/{sample_idx}/{self.rank}",
+                    repr(float(score)).encode())
+        if self.rank == 0:
+            total = 0.0
+            for r in range(self.world_size):
+                data = self.kv.wait(f"{self.prefix}/score/{sample_idx}/{r}",
+                                    timeout=self.timeout)
+                total += float(data.decode())
+            decision = local_decision(total / self.world_size)
+            self.kv.put(f"{self.prefix}/decision/{sample_idx}",
+                        json.dumps(decision).encode())
+            return decision
+        data = self.kv.wait(f"{self.prefix}/decision/{sample_idx}",
+                            timeout=self.timeout)
+        return json.loads(data.decode())
+
+
+# ---------------------------------------------------------------------------
+# process-wide manager (mirrors engine_service's lazy singleton)
+# ---------------------------------------------------------------------------
+
+_manager: ParameterManager | None = None
+_manager_lock = threading.Lock()
+_checked = False
+
+
+def get_manager() -> ParameterManager | None:
+    """The process's autotuner, or None when HVD_AUTOTUNE is off."""
+    global _manager, _checked
+    if _manager is not None or _checked:
+        return _manager
+    with _manager_lock:
+        if _manager is not None or _checked:
+            return _manager
+        _checked = True
+        if not envs.get_bool(envs.AUTOTUNE):
+            return None
+        sync = None
+        from . import runtime
+        if runtime.is_initialized() and runtime.process_count() > 1:
+            kv_addr = envs.get(envs.KV_ADDR)
+            if not kv_addr:
+                # Without a decision channel each process would explore the
+                # grid independently — and a per-process flip of
+                # HIERARCHICAL_ALLREDUCE changes the SPMD program, which
+                # deadlocks the job. Refuse rather than risk it (the
+                # reference likewise tunes through the controller,
+                # SynchronizeParameters).
+                hvd_logging.warning(
+                    "HVD_AUTOTUNE requested but this multi-process job has "
+                    "no launcher KV store to synchronize decisions; "
+                    "autotuning disabled (launch via hvdrun to enable)")
+                return None
+            from .runner.http_kv import KVClient
+            kv = KVClient(kv_addr, envs.get_int(envs.KV_PORT, 0),
+                          secret=envs.get(envs.SECRET_KEY))
+            sync = KVScoreSync(kv, runtime.process_count(),
+                               runtime.process_rank())
+        _manager = ParameterManager(sync=sync)
+        hvd_logging.info("autotune enabled: %s", _manager.current_config())
+    return _manager
+
+
+def record(nbytes: int) -> None:
+    """Hot-path hook called by the eager collectives."""
+    mgr = get_manager() if envs.get_bool(envs.AUTOTUNE) else None
+    if mgr is not None:
+        mgr.record(nbytes)
+
+
+def reset() -> None:
+    """Tear down (tests / elastic re-init)."""
+    global _manager, _checked
+    with _manager_lock:
+        if _manager is not None:
+            for t in _manager.tunables:
+                envs.clear_override(t.knob)
+            if _manager._log_writer:
+                _manager._log_file.close()
+        _manager = None
+        _checked = False

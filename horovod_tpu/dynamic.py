@@ -76,6 +76,9 @@ class Response:
     total_bytes: int = 0
     from_cache: bool = False
     error_message: str = ""
+    # ALLTOALL: rows this rank receives from each rank (negotiated; the
+    # reference's AlltoallGetRecvSplits metadata).
+    recv_splits: list = dataclasses.field(default_factory=list)
 
     @property
     def type_name(self) -> str:
@@ -143,9 +146,15 @@ def parse_responses(data: bytes) -> list[Response]:
         from_cache = r.u8() != 0
         err = r.str()
         names = [r.str() for _ in range(r.u32())]
+        recv_splits = []
+        for _ in range(r.u32()):
+            (v,) = struct.unpack_from("<i", r.buf, r.pos)
+            r.pos += 4
+            recv_splits.append(v)
         out.append(Response(type=t, tensor_names=names, dtype=dtype,
                             root_rank=root, total_bytes=total,
-                            from_cache=from_cache, error_message=err))
+                            from_cache=from_cache, error_message=err,
+                            recv_splits=recv_splits))
     return out
 
 
@@ -218,12 +227,19 @@ class NativeEngine:
 
     def enqueue(self, name: str, request_type: int, *, dtype: int = 0,
                 element_size: int = 4, shape=(), root_rank: int = -1,
-                group_id: int = -1) -> None:
+                group_id: int = -1, splits=()) -> None:
         shape = tuple(int(d) for d in shape)
         arr = (ctypes.c_int64 * len(shape))(*shape)
+        splits = tuple(int(s) for s in splits)
+        sarr = (ctypes.c_int32 * len(splits))(*splits)
         rc = self._lib.hvd_engine_enqueue(
             self._h, name.encode(), request_type, dtype, element_size,
-            arr, len(shape), root_rank, group_id)
+            arr, len(shape), root_rank, group_id, sarr, len(splits))
+        if rc == -3:
+            raise ValueError(
+                f"invalid alltoall splits for {name!r}: must be length "
+                "world_size, non-negative, and sum to at most the tensor's "
+                "first dimension (reference operations.cc:1691-1727)")
         if rc == -2:
             raise DuplicateNameError(
                 f"tensor name {name!r} is still in flight from a timed-out "

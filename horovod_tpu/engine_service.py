@@ -100,6 +100,10 @@ class DynamicService:
                  cycle_time_s: float | None = None):
         self.engine = engine
         self.transport = transport
+        # With no explicit value the knob is re-read every cycle so the
+        # autotuner's CYCLE_TIME override takes effect live (the reference's
+        # ParameterManager adjusts cycle time mid-run the same way).
+        self._cycle_time_from_knob = cycle_time_s is None
         if cycle_time_s is None:
             cycle_time_s = envs.get_float(
                 envs.CYCLE_TIME, DEFAULT_KV_CYCLE_TIME_MS) / 1000.0
@@ -119,14 +123,16 @@ class DynamicService:
 
     def negotiate(self, name: str, request_type: int, *, dtype: int = 0,
                   element_size: int = 4, shape=(), root_rank: int = -1,
-                  group_id: int = -1,
+                  group_id: int = -1, splits=(),
                   timeout: float | None = None) -> Response:
         """Enqueue a request and block until the global plan includes it
-        (the eager analog of ``EnqueueTensorAllreduce`` + handle wait)."""
+        (the eager analog of ``EnqueueTensorAllreduce`` + handle wait).
+        ``splits`` carries uneven-alltoall metadata; the negotiated
+        recv-splits come back on ``Response.recv_splits``."""
         return self.negotiate_many([dict(
             name=name, request_type=request_type, dtype=dtype,
             element_size=element_size, shape=shape, root_rank=root_rank,
-            group_id=group_id)], timeout=timeout)[0]
+            group_id=group_id, splits=splits)], timeout=timeout)[0]
 
     def negotiate_many(self, requests: list[dict],
                        timeout: float | None = None) -> list[Response]:
@@ -154,7 +160,8 @@ class DynamicService:
                         element_size=req.get("element_size", 4),
                         shape=req.get("shape", ()),
                         root_rank=req.get("root_rank", -1),
-                        group_id=req.get("group_id", -1))
+                        group_id=req.get("group_id", -1),
+                        splits=req.get("splits", ()))
                 except Exception:
                     # Roll back this batch's already-enqueued members so a
                     # mid-batch failure doesn't poison their names forever.
@@ -230,6 +237,9 @@ class DynamicService:
                 hvd_logging.exception("engine cycle failed")
                 self._fail_all(f"engine negotiation failed: {e}")
                 return
+            if self._cycle_time_from_knob:
+                self.cycle_time_s = envs.get_float(
+                    envs.CYCLE_TIME, DEFAULT_KV_CYCLE_TIME_MS) / 1000.0
             elapsed = time.monotonic() - start
             self._shutdown.wait(max(0.0, self.cycle_time_s - elapsed))
 

@@ -28,6 +28,13 @@ from .hierarchical import (
     hierarchical_allreduce,
     hierarchical_mesh,
 )
+from .sparse import (
+    SparseRows,
+    rows_from_dense,
+    rows_to_dense,
+    sparse_allreduce,
+    sparse_allreduce_to_dense,
+)
 
 __all__ = [
     "Adasum", "Average", "Max", "Min", "Product", "ReduceOp", "Sum",
@@ -37,4 +44,6 @@ __all__ = [
     "broadcast_object", "grouped_allreduce", "grouped_broadcast", "join", "per_rank", "poll",
     "reducescatter", "synchronize", "adasum_allreduce",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
+    "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce",
+    "sparse_allreduce_to_dense",
 ]

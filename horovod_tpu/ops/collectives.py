@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import autotune as _autotune
 from .. import runtime
 from .. import timeline as _timeline
 from ..dynamic import (
@@ -284,17 +285,37 @@ def _eager_grouped_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
 
 
 def _fuse_by_dtype(bundles: list, n: int):
-    """Pack (n, ...) bundles into one flat (n, total) wire buffer per dtype
+    """Pack (n, ...) bundles into flat (n, total) wire buffers per dtype
     (the XLA analog of the reference's fusion buffer,
-    ``fusion_buffer_manager.h:30-50``). Returns (fused_inputs, metas)."""
+    ``fusion_buffer_manager.h:30-50``), each bucket capped at the fusion
+    threshold (``HVD_FUSION_THRESHOLD``; reference default 128 MB,
+    ``operations.cc:491-496`` — the autotuner tunes this knob at runtime).
+    Returns (fused_inputs, metas)."""
+    from ..utils import envs as _envs
+    threshold = _envs.fusion_threshold_bytes()
     by_dtype: dict = {}
     for i, b in enumerate(bundles):
         by_dtype.setdefault(jnp.result_type(b), []).append(i)
     fused_inputs, metas = [], []
     for dt, idxs in by_dtype.items():
-        flat = [bundles[i].reshape(n, -1) for i in idxs]
-        fused_inputs.append(jnp.concatenate(flat, axis=1))
-        metas.append((dt, idxs, [bundles[i].shape[1:] for i in idxs]))
+        itemsize = jnp.dtype(dt).itemsize
+        bucket: list = []
+        bucket_bytes = 0
+        buckets = [bucket]
+        for i in idxs:
+            nbytes = int(np.prod(bundles[i].shape[1:]) or 1) * itemsize
+            if bucket and bucket_bytes + nbytes > threshold:
+                bucket = []
+                bucket_bytes = 0
+                buckets.append(bucket)
+            bucket.append(i)
+            bucket_bytes += nbytes
+        for bidxs in buckets:
+            if not bidxs:
+                continue
+            flat = [bundles[i].reshape(n, -1) for i in bidxs]
+            fused_inputs.append(jnp.concatenate(flat, axis=1))
+            metas.append((dt, bidxs, [bundles[i].shape[1:] for i in bidxs]))
     return fused_inputs, metas
 
 
@@ -316,6 +337,28 @@ def _eager_alltoall_fn(mesh: Mesh, axis: str):
         return _alltoall_traced(x[0], axis, None)
     return jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_uneven_alltoall_fn(mesh: Mesh, axis: str):
+    """Padded uneven alltoall: each rank gathers its per-destination chunks
+    (host-precomputed indices), zero-pads them to the global max chunk, and
+    exchanges them with one ``lax.all_to_all``; the ragged valid parts are
+    sliced back out by the caller (the reference's MPI_Alltoallv becomes
+    pad + all_to_all + slice under XLA's static shapes)."""
+
+    def inner(x, idx, mask):
+        # x: (1, d0, ...); idx/mask: (1, n, max_chunk)
+        sel = x[0][idx[0]]  # (n, max_chunk, ...) chunk for each destination
+        m = mask[0].reshape(mask.shape[1:] + (1,) * (sel.ndim - 2))
+        sel = jnp.where(m, sel, jnp.zeros((), sel.dtype))
+        # recv[j] = the chunk rank j addressed to me
+        return lax.all_to_all(sel, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False))
 
 
 @functools.lru_cache(maxsize=None)
@@ -400,12 +443,13 @@ def _auto_name(kind: str) -> str:
 
 def _negotiate_eager(kind: str, request_type: int, name: str | None,
                      shape, dtype, pset: ProcessSet,
-                     root_rank: int = -1) -> None:
+                     root_rank: int = -1, splits=()):
     """Gate a multi-process eager collective through the dynamic engine
     (no-op for single-process jobs). Guarantees identical global op order
     and turns metadata disagreements into informative errors instead of
     hangs/corrupt reductions (the reference's negotiation role,
-    ``controller.cc:73-430``).
+    ``controller.cc:73-430``). Returns the negotiated Response (None when
+    no service runs) — uneven alltoall reads ``recv_splits`` off it.
 
     Only global-set collectives negotiate: a subset process set may exclude
     entire processes, which legally never submit the op — negotiating over
@@ -413,16 +457,16 @@ def _negotiate_eager(kind: str, request_type: int, name: str | None,
     controller per process set instead; subset validation is future work).
     """
     if not pset.is_global:
-        return
+        return None
     from .. import engine_service
     svc = engine_service.get_service()
     if svc is None:
-        return
+        return None
     dt = jnp.dtype(dtype)
-    svc.negotiate(name or _auto_name(kind), request_type,
-                  dtype=_dtype_id(dt),
-                  element_size=dt.itemsize, shape=tuple(shape),
-                  root_rank=root_rank)
+    return svc.negotiate(name or _auto_name(kind), request_type,
+                         dtype=_dtype_id(dt),
+                         element_size=dt.itemsize, shape=tuple(shape),
+                         root_rank=root_rank, splits=splits)
 
 
 def _negotiate_eager_group(kind: str, request_type: int, name: str | None,
@@ -482,6 +526,7 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
     bundle, _ = _as_bundle(tensor, pset)
     _negotiate_eager("allreduce", REQ_ALLREDUCE, name, bundle.shape[1:],
                      bundle.dtype, pset)
+    _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "allreduce", "ALLREDUCE"):
         if (lowered_op == ReduceOp.SUM
                 and hierarchical.hierarchical_enabled_for(pset)):
@@ -536,6 +581,7 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
     _negotiate_eager_group("grouped_allreduce", REQ_ALLREDUCE, name,
                            [(b.shape[1:], b.dtype) for b in bundles], pset)
+    _autotune.record(sum(b.nbytes // max(b.shape[0], 1) for b in bundles))
     with _timeline.op_range(name or "grouped_allreduce", "GROUPED_ALLREDUCE"):
         if (lowered_op == ReduceOp.SUM
                 and hierarchical.hierarchical_enabled_for(pset)):
@@ -574,6 +620,7 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
     bundle, _ = _as_bundle(tensor, pset)
     _negotiate_eager("allgather", REQ_ALLGATHER, name, bundle.shape[1:],
                      bundle.dtype, pset)
+    _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "allgather", "ALLGATHER"):
         if hierarchical.hierarchical_allgather_enabled_for(pset):
             # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
@@ -609,6 +656,7 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
     root_pos = pset.ranks.index(root_rank)
     _negotiate_eager("broadcast", REQ_BROADCAST, name, bundle.shape[1:],
                      bundle.dtype, pset, root_rank=root_rank)
+    _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "broadcast", "BROADCAST"):
         return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
 
@@ -653,15 +701,29 @@ def grouped_broadcast(tensors: Sequence, root_rank: int, *,
 def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
              name: str | None = None, axis_name=None):
     """All-to-all along dim 0 (reference ``hvd.alltoall``,
-    ``operations.cc:1642-1727``). Equal splits only for now: rank *i*'s
-    j-th chunk of ``size`` equal chunks goes to rank *j* (uneven ``splits``
-    land with the dynamic engine)."""
-    if splits is not None:
-        raise NotImplementedError(
-            "uneven alltoall splits are not supported yet; pass tensors with "
-            "dim0 divisible by the process-set size")
+    ``operations.cc:1642-1727``).
+
+    Even mode (``splits=None``): rank *i*'s j-th of ``size`` equal chunks
+    goes to rank *j*; returns a :class:`PerRank`.
+
+    Uneven mode (``splits`` given): eager only (the reference likewise has
+    no jit path — dynamic output shapes). ``splits`` is either one row of
+    length ``size`` (every rank sends the same split pattern) or the full
+    ``(size, size)`` matrix ``splits[i][j]`` = rows rank *i* sends rank *j*
+    (the single-controller eager model sees every rank's metadata, like
+    :func:`per_rank` bundles carry every rank's data). Row sums may be less
+    than dim 0 — trailing rows are simply not sent, matching the
+    reference's ``sum <= first_dim`` contract (``operations.cc:1703-1707``).
+    Returns ``(outputs, recv_splits)``: ``outputs[r]`` is rank *r*'s
+    received concatenation and ``recv_splits[r][j]`` the rows it got from
+    rank *j* (the reference's second output tensor,
+    ``collective_operations.h:261-269``). In multi-process jobs the splits
+    metadata is cross-validated through the dynamic engine
+    (``AlltoallGetRecvSplits`` analog)."""
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
+    if splits is not None:
+        return _alltoall_uneven(tensor, splits, pset, axis, name)
     if _axis_is_bound(axis):
         return _alltoall_traced(tensor, axis, pset.axis_index_groups())
     if _contains_tracer(tensor):
@@ -679,6 +741,73 @@ def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
     with _timeline.op_range(name or "alltoall", "ALLTOALL"):
         out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
     return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
+
+
+def _alltoall_uneven(tensor, splits, pset: ProcessSet, axis,
+                     name: str | None):
+    """Uneven eager alltoall: pad each per-destination chunk to the global
+    max split, exchange with one ``lax.all_to_all``, slice the ragged valid
+    parts back out (MPI_Alltoallv under XLA's static shapes)."""
+    if _contains_tracer(tensor) or _axis_is_bound(axis):
+        raise RuntimeError(
+            "alltoall with uneven splits is eager-only: output shapes "
+            "depend on the splits, which XLA's static shapes cannot carry "
+            "through jit (the reference's uneven path is likewise "
+            "runtime-dispatched, operations.cc:1642-1727)")
+    n = pset.size()
+    bundle, _ = _as_bundle(tensor, pset)
+    d0 = bundle.shape[1]
+    smat = np.asarray(splits, dtype=np.int64)
+    if smat.ndim == 1:
+        smat = np.broadcast_to(smat, (n, n)).copy()
+    if smat.shape != (n, n):
+        raise ValueError(
+            f"splits must be one row of length {n} or a ({n}, {n}) matrix, "
+            f"got shape {tuple(smat.shape)}")
+    if (smat < 0).any():
+        raise ValueError("splits entries must be non-negative")
+    if (smat.sum(axis=1) > d0).any():
+        raise ValueError(
+            f"sum of splits entries exceeds the first dimension ({d0}) "
+            "(reference operations.cc:1703-1707)")
+
+    # Cross-validate the splits through the engine only when chip ranks and
+    # processes coincide (1 chip per process — the hvdrun CPU case, where
+    # the engine's world matches the matrix dimensions); with multi-chip
+    # processes the engine still orders the op but the chip-level splits
+    # matrix has no per-process row to submit.
+    one_chip_per_process = pset.size() == runtime.process_count()
+    my_row = smat[runtime.process_rank()] if one_chip_per_process else ()
+    resp = _negotiate_eager("alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
+                            bundle.dtype, pset, splits=tuple(int(s) for s in my_row))
+    recv_splits = smat.T.copy()  # recv_splits[r][j] = rows rank j sends rank r
+    if resp is not None and resp.recv_splits and one_chip_per_process:
+        mine = list(recv_splits[runtime.process_rank()])
+        if list(resp.recv_splits) != mine:
+            raise ValueError(
+                f"negotiated recv_splits {resp.recv_splits} disagree with "
+                f"the local splits matrix column {mine}; processes passed "
+                "different splits for the same alltoall")
+
+    max_chunk = max(int(smat.max()), 1)
+    offsets = np.zeros((n, n), np.int64)
+    offsets[:, 1:] = np.cumsum(smat, axis=1)[:, :-1]
+    k_range = np.arange(max_chunk)
+    idx = np.minimum(offsets[:, :, None] + k_range[None, None, :], d0 - 1)
+    mask = k_range[None, None, :] < smat[:, :, None]
+
+    with _timeline.op_range(name or "alltoall", "ALLTOALL"):
+        out = _eager_uneven_alltoall_fn(pset.mesh(), axis)(
+            bundle, jnp.asarray(idx, jnp.int32), jnp.asarray(mask))
+    # out: (n*n, max_chunk, ...); rows [r*n:(r+1)*n] = rank r's received
+    # padded chunks, one per source rank
+    out = out.reshape((n, n, max_chunk) + bundle.shape[2:])
+    outputs = []
+    for r in range(n):
+        parts = [out[r, j, :int(recv_splits[r, j])] for j in range(n)]
+        outputs.append(jnp.concatenate(parts, axis=0) if parts else
+                       jnp.zeros((0,) + bundle.shape[2:], bundle.dtype))
+    return outputs, recv_splits.astype(np.int32)
 
 
 def reducescatter(tensor, *, op: ReduceOp = ReduceOp.SUM,

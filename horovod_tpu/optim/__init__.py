@@ -19,34 +19,102 @@ TPU-native rebuild of the reference's optimizer surface:
 
 from __future__ import annotations
 
+import re
 from typing import Any
 
 import jax
 import optax
 
 from ..ops import collectives
+from ..ops import sparse as sparse_ops
 from ..ops.compression import Compression, Compressor
 from ..ops.reduce_ops import ReduceOp
 from ..process_sets import ProcessSet
 
 
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", str(p))
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def _sparse_rows_for(path_str: str, sparse_gradient_paths, sparse_max_rows):
+    """max_rows for a sparse-routed leaf, or None for the dense path."""
+    if not sparse_gradient_paths:
+        return None
+    for pat in sparse_gradient_paths:
+        if re.search(pat, path_str):
+            if isinstance(sparse_max_rows, dict):
+                for k, v in sparse_max_rows.items():
+                    if re.search(k, path_str):
+                        return int(v)
+                raise ValueError(
+                    f"sparse gradient leaf {path_str!r} matched "
+                    f"{pat!r} but sparse_max_rows has no entry for it")
+            return int(sparse_max_rows)
+    return None
+
+
 def _allreduce_tree(tree, *, op, process_set, compression, prescale_factor,
-                    postscale_factor, axis_name):
+                    postscale_factor, axis_name,
+                    sparse_gradient_paths=None, sparse_max_rows=None):
     """Allreduce every leaf of a gradient pytree with dtype-fused wire
-    buffers (eager) or per-leaf psum (traced; XLA fuses)."""
-    leaves, treedef = jax.tree.flatten(tree)
-    if not leaves:
+    buffers (eager) or per-leaf psum (traced; XLA fuses). Leaves whose key
+    path matches ``sparse_gradient_paths`` take the indexed-rows allgather
+    path instead (wire traffic ∝ touched rows — the reference's
+    IndexedSlices handling inside DistributedOptimizer)."""
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    if not path_leaves:
         return tree
-    compressed, ctxs = [], []
-    for leaf in leaves:
-        c, ctx = compression.compress(leaf)
-        compressed.append(c)
-        ctxs.append(ctx)
-    reduced = collectives.grouped_allreduce(
-        compressed, op=op, process_set=process_set,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        axis_name=axis_name)
-    out = [compression.decompress(r, ctx) for r, ctx in zip(reduced, ctxs)]
+    out: list = [None] * len(path_leaves)
+    dense_idx, dense_leaves = [], []
+    for i, (path, leaf) in enumerate(path_leaves):
+        max_rows = _sparse_rows_for(_path_str(path), sparse_gradient_paths,
+                                    sparse_max_rows)
+        if max_rows is not None and getattr(leaf, "ndim", 0) == 2:
+            axis = collectives._resolve_axis(axis_name)
+            if (collectives._contains_tracer(leaf)
+                    and not collectives._axis_is_bound(axis)):
+                # Plain jit/pjit (GSPMD): the partitioner already globally
+                # averaged the gradient — sync is the identity here exactly
+                # as on the dense path (_gspmd_passthrough_check).
+                collectives._gspmd_passthrough_check(op, "sparse_allreduce")
+                scale = prescale_factor * postscale_factor
+                out[i] = leaf if scale == 1.0 else leaf * scale
+            else:
+                # sparse leaves honor the same scaling/compression contract
+                # as the dense leaves in the tree (compression casts the
+                # wire dtype; scales bracket the reduction)
+                scaled = leaf if prescale_factor == 1.0 \
+                    else leaf * prescale_factor
+                c, ctx = compression.compress(scaled)
+                synced = sparse_ops.sparse_allreduce_to_dense(
+                    c, max_rows, op=op, process_set=process_set,
+                    axis_name=axis_name)
+                synced = compression.decompress(synced, ctx)
+                out[i] = synced if postscale_factor == 1.0 \
+                    else synced * postscale_factor
+        else:
+            dense_idx.append(i)
+            dense_leaves.append(leaf)
+    if dense_leaves:
+        compressed, ctxs = [], []
+        for leaf in dense_leaves:
+            c, ctx = compression.compress(leaf)
+            compressed.append(c)
+            ctxs.append(ctx)
+        reduced = collectives.grouped_allreduce(
+            compressed, op=op, process_set=process_set,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            axis_name=axis_name)
+        for i, r, ctx in zip(dense_idx, reduced, ctxs):
+            out[i] = compression.decompress(r, ctx)
     return jax.tree.unflatten(treedef, out)
 
 
@@ -55,6 +123,7 @@ def allreduce_gradients_transform(
         process_set: ProcessSet | None = None,
         compression: type[Compressor] = Compression.none,
         prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+        sparse_gradient_paths=None, sparse_max_rows=None,
         axis_name=None) -> optax.GradientTransformation:
     """An optax stage that allreduces incoming gradients."""
 
@@ -67,6 +136,8 @@ def allreduce_gradients_transform(
         synced = _allreduce_tree(
             updates, op=op, process_set=process_set, compression=compression,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            sparse_gradient_paths=sparse_gradient_paths,
+            sparse_max_rows=sparse_max_rows,
             axis_name=axis_name)
         return synced, state
 
@@ -80,6 +151,7 @@ def DistributedOptimizer(
         compression: type[Compressor] = Compression.none,
         prescale_factor: float = 1.0, postscale_factor: float = 1.0,
         backward_passes_per_step: int = 1,
+        sparse_gradient_paths=None, sparse_max_rows=None,
         axis_name=None) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally-reduced gradients
     (reference ``hvd.DistributedOptimizer``).
@@ -87,11 +159,21 @@ def DistributedOptimizer(
     With ``backward_passes_per_step > 1`` gradients accumulate locally
     (running mean, matching ``average_aggregated_gradients=True``) and the
     allreduce + inner update run every k-th step.
+
+    ``sparse_gradient_paths`` is a list of regexes matched against each
+    gradient leaf's ``/``-joined key path (e.g. ``["embedding"]``); matching
+    2-D leaves sync via the indexed-rows allgather path with per-step wire
+    traffic ∝ ``sparse_max_rows`` (an int, or a dict of path-regex → int)
+    instead of the full table — the reference's IndexedSlices handling
+    (``tensorflow/__init__.py:95-112``). ``HVD_SPARSE_AS_DENSE`` falls back
+    to dense allreduce.
     """
     distributed = optax.chain(
         allreduce_gradients_transform(
             op=op, process_set=process_set, compression=compression,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            sparse_gradient_paths=sparse_gradient_paths,
+            sparse_max_rows=sparse_max_rows,
             axis_name=axis_name),
         optimizer,
     )

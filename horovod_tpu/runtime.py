@@ -192,8 +192,10 @@ def shutdown() -> None:
     bound to this world's size/rank/KV prefix and must be rebuilt by the
     next init()."""
     global _state
+    from . import autotune as _autotune
     from . import engine_service as _engine_service
     _engine_service.reset_service()
+    _autotune.reset()
     with _lock:
         _state = None
 

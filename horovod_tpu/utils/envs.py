@@ -58,13 +58,44 @@ ELASTIC = "ELASTIC"  # "1" in workers launched by an elastic driver
 
 _PREFIXES = ("HVD_", "HOROVOD_")
 
+# Runtime knob overrides (autotuner). The reference's ParameterManager
+# mutates the live knob values in HorovodGlobalState while env-set knobs
+# stay fixed (``operations.cc:490-523``); here overrides sit *under* the
+# environment: an env-set knob always wins (it is "fixed"), and consumers
+# that read knobs through this module pick up tuned values transparently.
+_overrides: dict[str, str] = {}
+
+
+def set_override(name: str, value) -> None:
+    """Install a runtime override for knob ``name`` (autotuner)."""
+    _overrides[name] = str(value)
+
+
+def clear_override(name: str) -> None:
+    _overrides.pop(name, None)
+
+
+def clear_overrides() -> None:
+    _overrides.clear()
+
+
+def is_env_fixed(name: str) -> bool:
+    """True when the user pinned this knob via the environment — the
+    autotuner must treat it as untunable (reference ``SetAutoTuning`` /
+    fixed params, ``operations.cc:490-523``)."""
+    return any(os.environ.get(p + name) is not None for p in _PREFIXES)
+
 
 def get(name: str, default: str | None = None) -> str | None:
-    """Look up knob ``name`` under the HVD_/HOROVOD_ prefixes."""
+    """Look up knob ``name``: environment (HVD_/HOROVOD_ prefixes) first,
+    then runtime overrides, then ``default``."""
     for prefix in _PREFIXES:
         val = os.environ.get(prefix + name)
         if val is not None:
             return val
+    val = _overrides.get(name)
+    if val is not None:
+        return val
     return default
 
 

@@ -203,7 +203,8 @@ class Engine {
 
   int32_t enqueue(const char* name, int32_t request_type, int32_t dtype,
                   int32_t element_size, const int64_t* shape, int32_t ndim,
-                  int32_t root_rank, int32_t group_id) {
+                  int32_t root_rank, int32_t group_id,
+                  const int32_t* splits, int32_t nsplits) {
     std::lock_guard<std::mutex> lock(mu_);
     std::string key(name);
     if (outstanding_.count(key)) return -1;  // duplicate name still in flight
@@ -216,6 +217,20 @@ class Engine {
     q.group_id = group_id;
     q.name = std::move(key);
     q.shape.assign(shape, shape + ndim);
+    if (splits != nullptr && nsplits > 0) q.splits.assign(splits, splits + nsplits);
+    /* Splits validation mirrors EnqueueTensorAlltoall
+     * (operations.cc:1691-1727): right length, non-negative, sum within
+     * the tensor's first dimension. */
+    if (!q.splits.empty()) {
+      if (q.type != RequestType::ALLTOALL) return -3;
+      if (static_cast<int32_t>(q.splits.size()) != world_size_) return -3;
+      int64_t sum = 0;
+      for (int32_t s : q.splits) {
+        if (s < 0) return -3;
+        sum += s;
+      }
+      if (!q.shape.empty() && sum > q.shape[0]) return -3;
+    }
     /* Retry after abandon(): if this rank's original submission is still
      * being negotiated globally (table entry with our rank ready), do NOT
      * emit a second wire request — every rank would grow a ghost table
@@ -226,11 +241,38 @@ class Engine {
      * negotiation layer's core guarantee. */
     auto it = table_.find(q.name);
     if (it != table_.end() && it->second.ready_ranks.count(rank_)) {
-      const Request& orig = it->second.first;
+      const TableEntry& entry = it->second;
+      const Request& orig = entry.first;
       if (q.type != orig.type || q.dtype != orig.dtype ||
-          q.shape != orig.shape || q.root_rank != orig.root_rank) {
+          q.root_rank != orig.root_rank) {
         return -2;  // metadata differs from the in-flight negotiation
       }
+      bool dims_after_first = q.type == RequestType::ALLGATHER ||
+                              q.type == RequestType::ALLTOALL;
+      if (dims_after_first) {
+        /* dim0 is per-rank for gather/alltoall; compare rank-local dim0
+         * (recorded at ingest) and the shared trailing dims. */
+        bool ok = q.shape.size() == orig.shape.size();
+        for (size_t i = 1; ok && i < q.shape.size(); ++i)
+          ok = q.shape[i] == orig.shape[i];
+        if (q.type == RequestType::ALLTOALL) {
+          auto dit = entry.dim0_by_rank.find(rank_);
+          int64_t d0 = q.shape.empty() ? 0 : q.shape[0];
+          ok = ok && (dit == entry.dim0_by_rank.end() || dit->second == d0);
+        }
+        if (!ok) return -2;
+      } else if (q.shape != orig.shape) {
+        return -2;
+      }
+      /* Splits are rank-local too: a retry must match THIS rank's
+       * in-flight row (recorded in splits_by_rank), not rank 0's — other
+       * ranks' recv_splits were computed from the original row, so a
+       * silent change would misroute data. */
+      auto sit = entry.splits_by_rank.find(rank_);
+      const std::vector<int32_t> no_splits;
+      const std::vector<int32_t>& orig_splits =
+          sit == entry.splits_by_rank.end() ? no_splits : sit->second;
+      if (q.splits != orig_splits) return -2;
       outstanding_.insert(q.name);
       local_inflight_[q.name] = std::move(q);
       return 1;  // re-attached to in-flight negotiation
@@ -292,10 +334,16 @@ class Engine {
         e.ready_ranks.insert(rank);
         e.first_seen = now;
         e.sequence = next_sequence_++;
+        if (!q.splits.empty()) e.splits_by_rank[rank] = q.splits;
+        if (q.type == RequestType::ALLTOALL)
+          e.dim0_by_rank[rank] = q.shape.empty() ? 0 : q.shape[0];
         table_.emplace(q.name, std::move(e));
       } else {
         TableEntry& e = it->second;
         validate(e, q, rank);
+        if (!q.splits.empty()) e.splits_by_rank[rank] = q.splits;
+        if (q.type == RequestType::ALLTOALL)
+          e.dim0_by_rank[rank] = q.shape.empty() ? 0 : q.shape[0];
         e.ready_ranks.insert(rank);
       }
     }
@@ -310,6 +358,8 @@ class Engine {
       const Request& q = kv.second;
       if (q.type == RequestType::BARRIER || q.type == RequestType::JOIN)
         continue;  // never cached (controller.cc:100-104)
+      if (!q.splits.empty())
+        continue;  // uneven alltoall: recv_splits vary per call, never HIT
       if (cache_.cached(q) == ResponseCache::State::HIT) {
         int32_t bit = cache_.bit_of(q.name);
         if (bit >= 0) bits_buf_[bit / 8] |= (1u << (bit % 8));
@@ -326,6 +376,7 @@ class Engine {
     std::vector<std::string> served;
     for (auto& kv : local_inflight_) {
       const Request& q = kv.second;
+      if (!q.splits.empty()) continue;  // uneven alltoall never cache-served
       /* INVALID entries were already erased during ingest() — driven by
        * the global request stream so every rank erased identically; a
        * local-only erase here would desynchronize bit positions. */
@@ -421,9 +472,10 @@ class Engine {
       join_pending_ = false;
     }
 
-    // mark scheduled tensors complete + populate the cache
+    // mark scheduled tensors complete + populate the cache (uneven
+    // alltoalls stay uncached: their recv_splits are call-specific)
     for (const TableEntry* e : schedulable) {
-      if (e->first.type != RequestType::BARRIER) {
+      if (e->first.type != RequestType::BARRIER && e->splits_by_rank.empty()) {
         Response proto;
         proto.type = static_cast<ResponseType>(e->first.type);
         proto.dtype = e->first.dtype;
@@ -515,6 +567,11 @@ class Engine {
     uint64_t sequence = 0;
     bool done = false;
     std::string error_message;
+    /* ALLTOALL: each rank's submitted uneven splits row (absent = even).
+     * The transpose column for this engine's rank becomes the response's
+     * recv_splits (AlltoallGetRecvSplits analog). */
+    std::map<int32_t, std::vector<int32_t>> splits_by_rank;
+    std::map<int32_t, int64_t> dim0_by_rank;
   };
 
   bool all_ranks_in(const TableEntry& e) const {
@@ -613,6 +670,24 @@ class Engine {
         r.root_rank = q.root_rank;
         r.total_bytes = bytes;
         r.tensor_names = {q.name};
+        if (q.type == RequestType::ALLTOALL) {
+          /* Negotiated recv-splits for this engine's rank: rank j sends us
+           * splits_j[rank_] rows (its even share when it sent no splits) —
+           * the reference's AlltoallGetRecvSplits metadata exchange
+           * (collective_operations.h:219-221). */
+          r.recv_splits.resize(world_size_);
+          for (int32_t j = 0; j < world_size_; ++j) {
+            auto sit = e->splits_by_rank.find(j);
+            if (sit != e->splits_by_rank.end()) {
+              r.recv_splits[j] = sit->second[rank_];
+            } else {
+              auto dit = e->dim0_by_rank.find(j);
+              int64_t d0 = dit == e->dim0_by_rank.end() ? 0 : dit->second;
+              r.recv_splits[j] =
+                  static_cast<int32_t>(world_size_ ? d0 / world_size_ : 0);
+            }
+          }
+        }
         result.responses.push_back(std::move(r));
         continue;
       }
@@ -698,10 +773,11 @@ void hvd_engine_destroy(hvd_engine_t engine) {
 int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
                            int32_t request_type, int32_t dtype,
                            int32_t element_size, const int64_t* shape,
-                           int32_t ndim, int32_t root_rank, int32_t group_id) {
+                           int32_t ndim, int32_t root_rank, int32_t group_id,
+                           const int32_t* splits, int32_t nsplits) {
   return static_cast<hvd::Engine*>(engine)->enqueue(
       name, request_type, dtype, element_size, shape, ndim, root_rank,
-      group_id);
+      group_id, splits, nsplits);
 }
 
 int32_t hvd_engine_pop_requests(hvd_engine_t engine, const uint8_t** out,

@@ -73,14 +73,19 @@ void hvd_engine_destroy(hvd_engine_t engine);
  * caller (only equality matters for mismatch checks / fusion classes);
  * element_size is bytes per element for fusion accounting. root_rank is
  * used by BROADCAST, group_id groups tensors for joint fusion (-1 = none).
+ * splits/nsplits carry ALLTOALL uneven-splits metadata (how many dim-0
+ * rows this rank sends each rank; NULL/0 = even splits); the negotiated
+ * recv-splits come back on the ALLTOALL response.
  * Returns 0 (queued), 1 (re-attached to this rank's still-in-flight
  * negotiation after an abandon — no new wire request is emitted), -1 on
- * duplicate name still pending (common.h:229-232), or -2 when a
- * post-abandon retry's metadata differs from the in-flight negotiation. */
+ * duplicate name still pending (common.h:229-232), -2 when a
+ * post-abandon retry's metadata differs from the in-flight negotiation,
+ * or -3 on invalid splits (wrong length, negative, sum > dim0). */
 int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
                            int32_t request_type, int32_t dtype,
                            int32_t element_size, const int64_t* shape,
-                           int32_t ndim, int32_t root_rank, int32_t group_id);
+                           int32_t ndim, int32_t root_rank, int32_t group_id,
+                           const int32_t* splits, int32_t nsplits);
 
 /* Serialize and clear this rank's pending requests (the per-cycle
  * PopMessagesFromQueue, controller.cc:92). */

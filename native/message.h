@@ -64,6 +64,10 @@ struct Request {
   int32_t group_id = -1;
   std::string name;
   std::vector<int64_t> shape;
+  /* ALLTOALL only: how many dim-0 rows this rank sends to each rank
+   * (the reference's uneven-splits metadata, operations.cc:1691-1717).
+   * Empty = even splits. */
+  std::vector<int32_t> splits;
 
   int64_t num_elements() const {
     int64_t n = 1;
@@ -82,6 +86,8 @@ struct Request {
     w.str(name);
     w.u32(static_cast<uint32_t>(shape.size()));
     for (int64_t d : shape) w.i64(d);
+    w.u32(static_cast<uint32_t>(splits.size()));
+    for (int32_t s : splits) w.i32(s);
   }
 
   static Request parse(Reader& r) {
@@ -96,6 +102,9 @@ struct Request {
     uint32_t nd = r.u32();
     q.shape.resize(nd);
     for (uint32_t i = 0; i < nd; ++i) q.shape[i] = r.i64();
+    uint32_t ns = r.u32();
+    q.splits.resize(ns);
+    for (uint32_t i = 0; i < ns; ++i) q.splits[i] = r.i32();
     return q;
   }
 };
@@ -124,6 +133,11 @@ struct Response {
   bool from_cache = false;
   std::string error_message;
   std::vector<std::string> tensor_names;
+  /* ALLTOALL only: rows this engine's rank receives from each rank — the
+   * negotiated metadata the reference exchanges via
+   * Controller::AlltoallGetRecvSplits (collective_operations.h:219-221).
+   * The one rank-dependent response field (each engine computes its own). */
+  std::vector<int32_t> recv_splits;
 
   void serialize(Writer& w) const {
     w.u8(static_cast<uint8_t>(type));
@@ -134,6 +148,8 @@ struct Response {
     w.str(error_message);
     w.u32(static_cast<uint32_t>(tensor_names.size()));
     for (const auto& n : tensor_names) w.str(n);
+    w.u32(static_cast<uint32_t>(recv_splits.size()));
+    for (int32_t s : recv_splits) w.i32(s);
   }
   static Response parse(Reader& r) {
     Response s;
@@ -146,6 +162,9 @@ struct Response {
     uint32_t n = r.u32();
     s.tensor_names.reserve(n);
     for (uint32_t i = 0; i < n; ++i) s.tensor_names.push_back(r.str());
+    uint32_t ns = r.u32();
+    s.recv_splits.resize(ns);
+    for (uint32_t i = 0; i < ns; ++i) s.recv_splits[i] = r.i32();
     return s;
   }
 };

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Scaling-efficiency harness on a virtual device mesh — the rebuild's
+analog of the reference's published scaling-efficiency metric
+(``/root/reference/docs/benchmarks.rst:13-43``: 90% scaling efficiency for
+ResNet-101/Inception-V3 at 512 GPUs, measured with
+``examples/tensorflow2/tensorflow2_synthetic_benchmark.py``).
+
+Real multi-chip hardware isn't available in this environment, so this
+measures what *can* be measured honestly on N virtual CPU devices that
+share one physical machine:
+
+  **Fixed total work, sharded over n devices.** All virtual devices share
+  the same cores, so weak scaling (n x work on the same silicon) is
+  meaningless here. Instead the total batch is held constant and sharded
+  over n ∈ {1,2,4,8}; ideal step time is flat, and any rise is the
+  framework's collective/partitioning overhead — the quantity scaling
+  efficiency actually stresses. efficiency(n) = t(1) / t(n).
+
+Runs the framework's real collective layer (DistributedOptimizer ->
+grouped_allreduce -> traced lax.psum) in ``flat`` mode and the two-level
+ICI/DCN schedule (``ops/hierarchical.py``) in ``hier`` mode.
+
+Writes SCALING_r{N}.json and prints one JSON line per configuration.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def child_main(n: int, mode: str, total_batch: int, iters: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet18
+    from horovod_tpu.ops import hierarchical
+
+    hvd.init()  # collective layer resolves the (global) process set
+    devs = jax.devices()[:n]
+    # local (non-sync) batch norm, matching the reference benchmark's
+    # semantics — gradient allreduce is the only cross-device traffic
+    model = ResNet18(num_classes=10, dtype=jnp.float32, axis_name=None)
+    rng = jax.random.PRNGKey(0)
+    images = np.random.default_rng(0).standard_normal(
+        (total_batch, 32, 32, 3), dtype=np.float32)
+    labels = np.random.default_rng(1).integers(0, 10, size=(total_batch,))
+
+    variables = model.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32),
+                           train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    inner = optax.sgd(0.05, momentum=0.9)
+
+    def loss_fn(p, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": p, "batch_stats": batch_stats}, images, train=True,
+            mutable=["batch_stats"])
+        one_hot = jax.nn.one_hot(labels, 10)
+        loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), -1))
+        return loss, mutated["batch_stats"]
+
+    if mode == "flat":
+        mesh = Mesh(np.array(devs), ("data",))
+        tx = hvd.DistributedOptimizer(inner, axis_name="data")
+        data_spec = P("data")
+    elif mode == "nosync":
+        # control: identical sharded execution with NO gradient sync —
+        # isolates the shared-core partitioned-execution overhead from the
+        # framework's collective overhead
+        mesh = Mesh(np.array(devs), ("data",))
+        tx = inner
+        data_spec = P("data")
+    elif mode == "hier":
+        ici = 2 if n % 2 == 0 else 1
+        mesh = Mesh(np.array(devs).reshape(n // ici, ici), ("dcn", "ici"))
+        tx = inner  # grads reduced explicitly below via the two-level schedule
+        data_spec = P(("dcn", "ici"))
+    else:
+        raise ValueError(mode)
+
+    opt_state = tx.init(params)
+
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        if mode == "hier":
+            grads = jax.tree.map(
+                lambda g: hierarchical.hierarchical_allreduce_traced(
+                    g, "ici", "dcn", op=hvd.ReduceOp.AVERAGE), grads)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, new_opt, loss
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(), data_spec, data_spec),
+        out_specs=(P(), P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1, 2))
+
+    images = jax.device_put(images, NamedSharding(mesh, data_spec))
+    labels = jax.device_put(labels, NamedSharding(mesh, data_spec))
+    rep = NamedSharding(mesh, P())
+    params = jax.device_put(params, rep)
+    batch_stats = jax.device_put(batch_stats, rep)
+    opt_state = jax.device_put(opt_state, rep)
+
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    # median-of-iters: virtual-device CPU timing is noisy
+    med = times[len(times) // 2]
+    print(json.dumps({"n": n, "mode": mode, "step_ms": round(med * 1e3, 3)}))
+
+
+def run_child(n: int, mode: str, total_batch: int, iters: int,
+              max_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={max_devices}")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in list(env):
+        if k.startswith(("HVD_", "HOROVOD_")):
+            env.pop(k)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_child",
+         str(n), mode, str(total_batch), str(iters)],
+        env=env, cwd=HERE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"scaling child n={n} mode={mode} failed:\n{proc.stderr[-4000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--_child", nargs=4, metavar=("N", "MODE", "BATCH", "ITERS"))
+    parser.add_argument("--devices", default="1,2,4,8")
+    parser.add_argument("--total-batch", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    if args._child:
+        n, mode, batch, iters = args._child
+        child_main(int(n), mode, int(batch), int(iters))
+        return
+
+    device_counts = [int(x) for x in args.devices.split(",")]
+    max_devices = max(device_counts)
+    results = []
+    base_ms = None
+    nosync_ms = {}
+    for n in device_counts:
+        modes = ["flat"] if n == 1 else ["nosync", "flat", "hier"]
+        for mode in modes:
+            r = run_child(n, mode, args.total_batch, args.iters, max_devices)
+            if base_ms is None:
+                base_ms = r["step_ms"]
+            if mode == "nosync":
+                nosync_ms[n] = r["step_ms"]
+            r["efficiency"] = round(base_ms / r["step_ms"], 3)
+            # collective-layer efficiency: vs the identical sharded run
+            # with no gradient sync (strips the shared-core partitioned-
+            # execution emulation overhead that real hardware doesn't have)
+            if mode in ("flat", "hier") and n in nosync_ms:
+                r["collective_efficiency"] = round(
+                    nosync_ms[n] / r["step_ms"], 3)
+            results.append(r)
+            print(json.dumps(r))
+
+    out = args.out or os.path.join(HERE, "SCALING_r3.json")
+    payload = {
+        "harness": "fixed-total-work strong scaling on virtual CPU devices",
+        "model": "ResNet18/32x32",
+        "total_batch": args.total_batch,
+        "metric": "efficiency = t(1)/t(n), ideal 1.0; collective_efficiency "
+                  "= t(nosync,n)/t(mode,n) isolates the framework's "
+                  "collective overhead from the shared-core partitioned-"
+                  "execution emulation overhead (all virtual devices share "
+                  "one physical core here)",
+        "reference_target": ">=0.90 collective_efficiency, mirroring "
+                            "docs/benchmarks.rst:13-14",
+        "results": results,
+    }
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(json.dumps({"metric": "collective_efficiency_8dev_flat",
+                      "value": next((r.get("collective_efficiency")
+                                     for r in results
+                                     if r["n"] == max_devices and r["mode"] == "flat"),
+                                    None),
+                      "unit": "ratio", "out": out}))
+
+
+if __name__ == "__main__":
+    main()
