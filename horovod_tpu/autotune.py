@@ -245,18 +245,37 @@ class KVScoreSync:
         self.kv.put(f"{self.prefix}/score/{sample_idx}/{self.rank}",
                     repr(float(score)).encode())
         if self.rank == 0:
-            total = 0.0
-            for r in range(self.world_size):
-                data = self.kv.wait(f"{self.prefix}/score/{sample_idx}/{r}",
-                                    timeout=self.timeout)
-                total += float(data.decode())
+            gather = getattr(self.kv, "gather", None)
+            if gather is not None:  # one server-side round (KVClient)
+                got = gather(f"{self.prefix}/score/{sample_idx}",
+                             self.world_size, timeout=self.timeout)
+                total = sum(float(v.decode()) for v in got.values())
+            else:  # plain mapping-style stores (tests)
+                total = 0.0
+                for r in range(self.world_size):
+                    data = self.kv.wait(
+                        f"{self.prefix}/score/{sample_idx}/{r}",
+                        timeout=self.timeout)
+                    total += float(data.decode())
             decision = local_decision(total / self.world_size)
             self.kv.put(f"{self.prefix}/decision/{sample_idx}",
                         json.dumps(decision).encode())
-            return decision
-        data = self.kv.wait(f"{self.prefix}/decision/{sample_idx}",
-                            timeout=self.timeout)
-        return json.loads(data.decode())
+        else:
+            data = self.kv.wait(f"{self.prefix}/decision/{sample_idx}",
+                                timeout=self.timeout)
+            decision = json.loads(data.decode())
+        # everyone has read sample_idx's keys before anyone writes
+        # sample_idx+2 (a rank must finish its own idx+1 reads first), so
+        # deleting the previous sample's keys bounds KV memory
+        if sample_idx > 0:
+            try:
+                self.kv.delete(
+                    f"{self.prefix}/score/{sample_idx - 1}/{self.rank}")
+                if self.rank == 0:
+                    self.kv.delete(f"{self.prefix}/decision/{sample_idx - 1}")
+            except Exception:
+                pass
+        return decision
 
 
 # ---------------------------------------------------------------------------

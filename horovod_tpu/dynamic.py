@@ -79,6 +79,14 @@ class Response:
     # ALLTOALL: rows this rank receives from each rank (negotiated; the
     # reference's AlltoallGetRecvSplits metadata).
     recv_splits: list = dataclasses.field(default_factory=list)
+    # Per-tensor shapes + group ids (aligned with tensor_names) and reduce
+    # parameters, so a JOINed rank can execute the identical program with
+    # zero inputs (reference JoinOp, collective_operations.h:275-290).
+    shapes: list = dataclasses.field(default_factory=list)
+    group_ids: list = dataclasses.field(default_factory=list)
+    reduce_op: int = -1
+    prescale: float = 1.0
+    postscale: float = 1.0
 
     @property
     def type_name(self) -> str:
@@ -118,6 +126,11 @@ class _Reader:
         self.pos += 4
         return v
 
+    def i32(self):
+        (v,) = struct.unpack_from("<i", self.buf, self.pos)
+        self.pos += 4
+        return v
+
     def i64(self):
         (v,) = struct.unpack_from("<q", self.buf, self.pos)
         self.pos += 8
@@ -140,21 +153,25 @@ def parse_responses(data: bytes) -> list[Response]:
     out = []
     for _ in range(r.u32()):
         t = r.u8()
-        dtype = struct.unpack_from("<i", r.buf, r.pos)[0]; r.pos += 4
-        root = struct.unpack_from("<i", r.buf, r.pos)[0]; r.pos += 4
+        dtype = r.i32()
+        root = r.i32()
         total = r.i64()
         from_cache = r.u8() != 0
         err = r.str()
         names = [r.str() for _ in range(r.u32())]
-        recv_splits = []
-        for _ in range(r.u32()):
-            (v,) = struct.unpack_from("<i", r.buf, r.pos)
-            r.pos += 4
-            recv_splits.append(v)
+        recv_splits = [r.i32() for _ in range(r.u32())]
+        shapes = [tuple(r.i64() for _ in range(r.u32()))
+                  for _ in range(r.u32())]
+        group_ids = [r.i32() for _ in range(r.u32())]
+        reduce_op = r.i32()
+        prescale = r.f64()
+        postscale = r.f64()
         out.append(Response(type=t, tensor_names=names, dtype=dtype,
                             root_rank=root, total_bytes=total,
                             from_cache=from_cache, error_message=err,
-                            recv_splits=recv_splits))
+                            recv_splits=recv_splits, shapes=shapes,
+                            group_ids=group_ids, reduce_op=reduce_op,
+                            prescale=prescale, postscale=postscale))
     return out
 
 
@@ -227,14 +244,18 @@ class NativeEngine:
 
     def enqueue(self, name: str, request_type: int, *, dtype: int = 0,
                 element_size: int = 4, shape=(), root_rank: int = -1,
-                group_id: int = -1, splits=()) -> None:
+                group_id: int = -1, splits=(), reduce_op: int = -1,
+                prescale: float = 1.0, postscale: float = 1.0,
+                splits_crc: int = 0) -> None:
         shape = tuple(int(d) for d in shape)
         arr = (ctypes.c_int64 * len(shape))(*shape)
         splits = tuple(int(s) for s in splits)
         sarr = (ctypes.c_int32 * len(splits))(*splits)
         rc = self._lib.hvd_engine_enqueue(
             self._h, name.encode(), request_type, dtype, element_size,
-            arr, len(shape), root_rank, group_id, sarr, len(splits))
+            arr, len(shape), root_rank, group_id, sarr, len(splits),
+            int(reduce_op), float(prescale), float(postscale),
+            int(splits_crc))
         if rc == -3:
             raise ValueError(
                 f"invalid alltoall splits for {name!r}: must be length "
@@ -325,14 +346,17 @@ def drive_cycle(engines: list[NativeEngine]) -> list[list[Response]]:
 
     The reference tests run real 2-process mpirun jobs; this in-memory
     multi-engine driver exercises the identical protocol without processes
-    (the transport — an allgather + bitwise AND — is played by plain
-    Python). Also documents the canonical cycle order for real transports.
+    (the transport — one batched allgather of (requests, cache bits) — is
+    played by plain Python). Also documents the canonical cycle order for
+    real transports: bits are computed against the pre-ingest cache state
+    (so bit positions agree on every member), the AND-served set commits
+    first, then ingest skips served names.
     """
     datas = [e.pop_requests() for e in engines]
-    for e in engines:
-        for rank, data in enumerate(datas):
-            e.ingest(rank, data)
     anded = and_bitvectors([e.cache_bits() for e in engines])
     for e in engines:
         e.commit_cache_bits(anded)
+    for e in engines:
+        for rank, data in enumerate(datas):
+            e.ingest(rank, data)
     return [e.compute_responses() for e in engines]

@@ -46,7 +46,18 @@ _STALL_CHECK_INTERVAL_S = 5.0
 class KVTransport:
     """Allgather/AND over the launcher KV server (the analog of the
     reference controller's MPI_Gatherv/Bcast transport,
-    ``mpi_controller.cc:135-207``)."""
+    ``mpi_controller.cc:135-207``).
+
+    One negotiation cycle costs exactly one KV round per member: the
+    request bytes and cache bitvector travel in one framed value, and the
+    server assembles all members' values in a single long-poll gather
+    (``KVClient.gather``). Scaling: per cycle the server handles O(world)
+    requests totalling O(sum of request bytes) — the same asymptotics as
+    the reference's MPI_Gatherv+Bcast, with the KV server in the
+    coordinator role. The earlier design's two sequential phases of
+    per-key polling (O(world²) server ops per cycle across the fleet) is
+    gone; for pod-scale worlds the remaining ceiling is the single
+    server's fan-in, which is also the reference's rank-0 ceiling."""
 
     def __init__(self, kv_client, world_size: int, rank: int,
                  prefix: str = "engine"):
@@ -55,33 +66,30 @@ class KVTransport:
         self.rank = rank
         self.prefix = prefix
 
-    def _gather(self, kind: str, cycle: int, mine: bytes,
-                timeout: float) -> list[bytes]:
-        self.kv.put(f"{self.prefix}/{kind}/{cycle}/{self.rank}", mine)
-        out = []
-        for r in range(self.world_size):
-            if r == self.rank:
-                out.append(mine)
-                continue
-            data = self.kv.wait(f"{self.prefix}/{kind}/{cycle}/{r}",
-                                timeout=timeout)
-            out.append(data)
+    def exchange(self, cycle: int, req_bytes: bytes, bits: bytes,
+                 timeout: float) -> tuple[list[bytes], list[bytes]]:
+        """One round: publish (requests, bits), collect everyone's."""
+        import struct
+        frame = struct.pack("<I", len(req_bytes)) + req_bytes + bits
+        self.kv.put(f"{self.prefix}/x/{cycle}/{self.rank}", frame)
+        got = self.kv.gather(f"{self.prefix}/x/{cycle}", self.world_size,
+                             timeout=timeout)
+        datas: list = [b""] * self.world_size
+        bitvs: list = [b""] * self.world_size
+        for k, v in got.items():
+            r = int(k.rsplit("/", 1)[1])
+            (ln,) = struct.unpack_from("<I", v, 0)
+            datas[r] = v[4:4 + ln]
+            bitvs[r] = v[4 + ln:]
         # Everyone read cycle-c data before anyone can write cycle c+2 (a
         # process must finish cycle c+1's own reads first), so deleting our
         # *previous* cycle's keys here is safe and bounds KV memory.
         if cycle > 0:
             try:
-                self.kv.delete(f"{self.prefix}/{kind}/{cycle - 1}/{self.rank}")
+                self.kv.delete(f"{self.prefix}/x/{cycle - 1}/{self.rank}")
             except Exception:
                 pass
-        return out
-
-    def exchange_requests(self, cycle: int, mine: bytes,
-                          timeout: float) -> list[bytes]:
-        return self._gather("req", cycle, mine, timeout)
-
-    def and_bits(self, cycle: int, mine: bytes, timeout: float) -> bytes:
-        return and_bitvectors(self._gather("bits", cycle, mine, timeout))
+        return datas, bitvs
 
 
 class _Pending:
@@ -111,6 +119,7 @@ class DynamicService:
         self._cycle = 0
         self._mu = threading.Lock()
         self._pending: dict[str, _Pending] = {}
+        self._joined = False
         self._failure: str | None = None
         self._shutdown = threading.Event()
         self._exchange_timeout = envs.get_float(envs.ELASTIC_TIMEOUT, 600.0)
@@ -123,7 +132,9 @@ class DynamicService:
 
     def negotiate(self, name: str, request_type: int, *, dtype: int = 0,
                   element_size: int = 4, shape=(), root_rank: int = -1,
-                  group_id: int = -1, splits=(),
+                  group_id: int = -1, splits=(), reduce_op: int = -1,
+                  prescale: float = 1.0, postscale: float = 1.0,
+                  splits_crc: int = 0,
                   timeout: float | None = None) -> Response:
         """Enqueue a request and block until the global plan includes it
         (the eager analog of ``EnqueueTensorAllreduce`` + handle wait).
@@ -132,7 +143,29 @@ class DynamicService:
         return self.negotiate_many([dict(
             name=name, request_type=request_type, dtype=dtype,
             element_size=element_size, shape=shape, root_rank=root_rank,
-            group_id=group_id, splits=splits)], timeout=timeout)[0]
+            group_id=group_id, splits=splits, reduce_op=reduce_op,
+            prescale=prescale, postscale=postscale,
+            splits_crc=splits_crc)], timeout=timeout)[0]
+
+    def join(self, name: str, timeout: float | None = None) -> int:
+        """Reference ``hvd.join`` (``operations.cc:1729-1761``): this
+        process stops contributing data; until every process joins, it
+        participates in collectives scheduled by the others with
+        zero-filled inputs (executed by the cycle thread from response
+        metadata). Returns the last joined process rank.
+
+        Blocks without a deadline by default, like the reference — peers
+        may legitimately train for arbitrarily long before joining (the
+        whole point of join); stall warnings still fire for visibility."""
+        from .dynamic import REQ_JOIN
+        self._joined = True
+        try:
+            resp = self.negotiate(name, REQ_JOIN,
+                                  timeout=timeout if timeout is not None
+                                  else float("inf"))
+        finally:
+            self._joined = False
+        return resp.root_rank
 
     def negotiate_many(self, requests: list[dict],
                        timeout: float | None = None) -> list[Response]:
@@ -161,7 +194,11 @@ class DynamicService:
                         shape=req.get("shape", ()),
                         root_rank=req.get("root_rank", -1),
                         group_id=req.get("group_id", -1),
-                        splits=req.get("splits", ()))
+                        splits=req.get("splits", ()),
+                        reduce_op=req.get("reduce_op", -1),
+                        prescale=req.get("prescale", 1.0),
+                        postscale=req.get("postscale", 1.0),
+                        splits_crc=req.get("splits_crc", 0))
                 except Exception:
                     # Roll back this batch's already-enqueued members so a
                     # mid-batch failure doesn't poison their names forever.
@@ -183,6 +220,11 @@ class DynamicService:
         try:
             for req, pend in zip(requests, pends):
                 remaining = end - time.monotonic()
+                if remaining == float("inf"):  # join: block like the reference
+                    while not pend.event.wait(60.0):
+                        if self._failure:
+                            break
+                    continue
                 if remaining <= 0 or not pend.event.wait(remaining):
                     timed_out = True
                     raise HorovodCollectiveError(
@@ -244,17 +286,21 @@ class DynamicService:
             self._shutdown.wait(max(0.0, self.cycle_time_s - elapsed))
 
     def _run_cycle(self):
+        # Canonical batched cycle (matches dynamic.drive_cycle): bits are
+        # computed against the PRE-ingest cache state on every member (so
+        # bit positions agree), the AND-served set commits first, and
+        # ingest then skips served names — one KV round per cycle.
         mine = self.engine.pop_requests()
+        mybits = self.engine.cache_bits()
         cycle = self._cycle
         self._cycle += 1
-        datas = self.transport.exchange_requests(cycle, mine,
-                                                 self._exchange_timeout)
+        datas, bitvs = self.transport.exchange(cycle, mine, mybits,
+                                               self._exchange_timeout)
+        self.engine.commit_cache_bits(and_bitvectors(bitvs))
         for rank, data in enumerate(datas):
             self.engine.ingest(rank, data)
-        anded = self.transport.and_bits(cycle, self.engine.cache_bits(),
-                                        self._exchange_timeout)
-        self.engine.commit_cache_bits(anded)
         responses = self.engine.compute_responses()
+        _timeline.mark_cycle()  # HVD_TIMELINE_MARK_CYCLES instant marker
         if responses:
             self._deliver(responses)
         now = time.monotonic()
@@ -263,8 +309,27 @@ class DynamicService:
             self._check_stalls()
 
     def _deliver(self, responses: list[Response]):
+        # While joined, responses for tensors this process never submitted
+        # are executed with zero inputs (reference JoinOp) BEFORE any
+        # claimed responses are delivered — the JOIN completion arrives
+        # last in the cycle, so the user thread cannot race the zero
+        # executions and cross-process collective order is preserved.
+        exec_batch: list[Response] = []
+        claimed_resps: list[Response] = []
         with self._mu:
-            for resp in responses:
+            joined = self._joined
+        for resp in responses:
+            with self._mu:
+                claimed = any(t in self._pending for t in resp.tensor_names)
+            if claimed:
+                claimed_resps.append(resp)
+            elif joined and not resp.is_error:
+                exec_batch.append(resp)
+        if exec_batch:
+            from .ops import collectives as _coll
+            _coll._execute_joined_zeros(exec_batch)  # raises on unsupported
+        with self._mu:
+            for resp in claimed_resps:
                 for tname in resp.tensor_names:
                     pend = self._pending.get(tname)
                     if pend is not None:
@@ -292,21 +357,35 @@ class DynamicService:
 
 
 # --------------------------------------------------------------------------
-# process-wide service (created lazily for multi-process eager jobs)
+# process-wide services (created lazily for multi-process eager jobs) — one
+# per process set, mirroring the reference's per-ProcessSet controller
+# (process_set.h:26-84): subset eager ops get the same ordering/mismatch/
+# stall guarantees as global ones, negotiated only among the member
+# processes (so non-members legally never submitting is not a stall).
 # --------------------------------------------------------------------------
 
-_service: DynamicService | None = None
+_services: dict = {}          # set key -> DynamicService
 _service_lock = threading.Lock()
-_service_unavailable = False
+_service_unavailable = False  # infra-level: knob off / no KV / no native
 
 
-def get_service() -> DynamicService | None:
-    """The process's negotiation service, or None when not applicable
-    (single-process job, knob disabled, no launcher KV, native engine
-    unavailable)."""
-    global _service, _service_unavailable
-    if _service is not None:
-        return _service
+def _set_key(pset) -> str:
+    """Stable cross-process key for a process set: registered id when
+    available, else a digest of the rank list (deterministic everywhere,
+    unlike id())."""
+    if pset is None or pset.is_global:
+        return "0"
+    if pset.process_set_id is not None:
+        return str(pset.process_set_id)
+    import zlib
+    return "u%x" % (zlib.crc32(repr(tuple(pset.ranks)).encode()) & 0xFFFFFFFF)
+
+
+def get_service(pset=None) -> DynamicService | None:
+    """The negotiation service for ``pset`` (default: global set), or None
+    when not applicable (single-process job, this process not a member,
+    knob disabled, no launcher KV, native engine unavailable)."""
+    global _service_unavailable
     if _service_unavailable:
         return None
     if not envs.get_bool("DYNAMIC_ENGINE", True):
@@ -319,9 +398,23 @@ def get_service() -> DynamicService | None:
     if not kv_addr:
         _service_unavailable = True
         return None
+
+    if pset is None or pset.is_global:
+        member_procs = list(range(runtime.process_count()))
+    else:
+        member_procs = sorted({runtime.process_of_rank(r)
+                               for r in pset.ranks})
+    me = runtime.process_rank()
+    if me not in member_procs or len(member_procs) <= 1:
+        return None
+    key = _set_key(pset)
+    svc = _services.get(key)
+    if svc is not None:
+        return svc
     with _service_lock:
-        if _service is not None or _service_unavailable:
-            return _service
+        svc = _services.get(key)
+        if svc is not None or _service_unavailable:
+            return svc
         try:
             from ._native import available
             if not available():
@@ -330,33 +423,35 @@ def get_service() -> DynamicService | None:
             from .runner.http_kv import KVClient
             kv = KVClient(kv_addr, envs.get_int(envs.KV_PORT, 0),
                           secret=envs.get(envs.SECRET_KEY))
-            engine = NativeEngine(world_size=runtime.process_count(),
-                                  rank=runtime.process_rank())
-            # Scope keys to this world instance: the coordinator endpoint
-            # changes every elastic round, so a fresh service can never
-            # read stale cycle keys left by the previous round.
-            prefix = "engine/{}:{}".format(
+            engine = NativeEngine(world_size=len(member_procs),
+                                  rank=member_procs.index(me))
+            # Scope keys to this world instance AND this process set: the
+            # coordinator endpoint changes every elastic round, so a fresh
+            # service can never read stale cycle keys left by the previous
+            # round; per-set scoping keeps concurrent sets' cycles apart.
+            prefix = "engine/{}:{}/ps{}".format(
                 envs.get(envs.COORDINATOR_ADDR, "local"),
-                envs.get(envs.COORDINATOR_PORT, "0"))
-            transport = KVTransport(kv, runtime.process_count(),
-                                    runtime.process_rank(), prefix=prefix)
-            _service = DynamicService(engine, transport)
+                envs.get(envs.COORDINATOR_PORT, "0"), key)
+            transport = KVTransport(kv, len(member_procs),
+                                    member_procs.index(me), prefix=prefix)
+            svc = DynamicService(engine, transport)
+            _services[key] = svc
             hvd_logging.info(
-                "dynamic engine service started: %d processes over KV %s",
-                runtime.process_count(), kv_addr)
+                "dynamic engine service started for set %s: %d processes "
+                "over KV %s", key, len(member_procs), kv_addr)
         except Exception as e:
             hvd_logging.warning("dynamic engine service unavailable: %s", e)
             _service_unavailable = True
-    return _service
+    return svc
 
 
 def reset_service() -> None:
-    """Tear down the process service (elastic re-init / tests)."""
-    global _service, _service_unavailable
+    """Tear down all per-set services (elastic re-init / tests)."""
+    global _service_unavailable
     with _service_lock:
-        if _service is not None:
-            _service.stop()
-            _service = None
+        for svc in _services.values():
+            svc.stop()
+        _services.clear()
         _service_unavailable = False
     # Auto-generated op names must restart from zero everywhere after a
     # world reset: surviving workers would otherwise keep counting while

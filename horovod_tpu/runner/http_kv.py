@@ -66,6 +66,9 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
             return
         store = self.server.store  # type: ignore[attr-defined]
         key = self._key()
+        if key.startswith("__gather__/"):
+            self._gather(store, key)
+            return
         with self.server.lock:  # type: ignore[attr-defined]
             if key.endswith("/") or key == "":  # scope listing
                 scope = key.rstrip("/")
@@ -79,6 +82,45 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _gather(self, store, key):
+        """Long-poll collect: ``__gather__/<scope>?count=N&timeout=S``
+        blocks until N keys exist under scope, then returns them framed
+        (sorted; u32 count, then per entry u32 klen + key + u32 vlen +
+        value). Turns the engine transport's O(world) GET polls per cycle
+        into one request per member (reference analog: the controller's
+        single MPI_Gatherv, ``mpi_controller.cc:135-179``)."""
+        import struct
+        from urllib.parse import parse_qs, urlparse
+        parsed = urlparse(key)
+        scope = parsed.path[len("__gather__/"):].rstrip("/")
+        q = parse_qs(parsed.query)
+        count = int(q.get("count", ["1"])[0])
+        timeout = min(float(q.get("timeout", ["30"])[0]), 60.0)
+        prefix = scope + "/" if scope else ""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self.server.lock:  # type: ignore[attr-defined]
+                keys = sorted(k for k in store if k.startswith(prefix))
+                if len(keys) >= count:
+                    parts = [struct.pack("<I", len(keys))]
+                    for k in keys:
+                        kb = k.encode()
+                        v = store[k]
+                        parts.append(struct.pack("<I", len(kb)) + kb
+                                     + struct.pack("<I", len(v)) + v)
+                    body = b"".join(parts)
+                    break
+            if time.monotonic() > deadline:
+                self.send_response(408)  # incomplete: client retries
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            time.sleep(0.002)
         self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -225,6 +267,47 @@ class KVClient:
             if time.monotonic() > deadline:
                 raise TimeoutError(f"KV key {key!r} not set within {timeout}s")
             time.sleep(poll_interval)
+
+    def gather(self, scope: str, count: int, timeout: float = 60.0) -> dict:
+        """Collect ``count`` keys under ``scope`` in one server-side
+        long-poll (server assembles; one HTTP round trip per call instead
+        of one poll loop per key). Returns {key: value}."""
+        import struct
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"KV gather {scope!r} did not reach {count} keys "
+                    f"within {timeout}s")
+            server_wait = max(min(remaining, 25.0), 0.05)
+            path = (f"/__gather__/{scope.rstrip('/')}"
+                    f"?count={count}&timeout={server_wait}")
+            try:
+                old = self._timeout
+                self._timeout = server_wait + 10.0
+                try:
+                    with self._request("GET", path) as resp:
+                        data = resp.read()
+                finally:
+                    self._timeout = old
+            except urllib.error.HTTPError as e:
+                if e.code == 408:  # server-side wait expired; retry
+                    continue
+                raise
+            out = {}
+            pos = 4
+            (n,) = struct.unpack_from("<I", data, 0)
+            for _ in range(n):
+                (klen,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                k = data[pos:pos + klen].decode()
+                pos += klen
+                (vlen,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                out[k] = data[pos:pos + vlen]
+                pos += vlen
+            return out
 
 
 @functools.lru_cache(maxsize=1)

@@ -291,3 +291,9 @@ def process_set_table():
 
 def local_ranks() -> list:
     return list(_get().local_ranks)
+
+
+def process_of_rank(global_rank: int) -> int:
+    """Index of the process owning chip ``global_rank`` (devices are
+    rank-ordered process-major)."""
+    return _get().devices[global_rank].process_index

@@ -42,20 +42,33 @@ def _get_engine():
     return _engine
 
 
+_mark_cycles = False
+
+
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     """Start recording eager collectives to ``file_path`` (Chrome trace
     JSON; open in ``chrome://tracing`` / Perfetto). Reference
     ``hvd.start_timeline`` → ``horovod_start_timeline``
-    (``operations.cc:1032-1064``)."""
-    global _active, _atexit_registered
-    del mark_cycles  # cycle marks need the dynamic service; accepted for parity
+    (``operations.cc:1032-1064``). With ``mark_cycles`` (or
+    ``HVD_TIMELINE_MARK_CYCLES``) every negotiation cycle of the dynamic
+    service drops an instant marker (``operations.cc:485-488``)."""
+    global _active, _atexit_registered, _mark_cycles
     with _lock:
         _get_engine().timeline_start(file_path)
         _active = True
+        _mark_cycles = bool(mark_cycles) or envs.get_bool(
+            envs.TIMELINE_MARK_CYCLES)
         if not _atexit_registered:
             import atexit
             atexit.register(stop_timeline)  # flushes on interpreter exit
             _atexit_registered = True
+
+
+def mark_cycle() -> None:
+    """Instant 'CYCLE' marker, called by the dynamic service's loop when
+    cycle marking is on (HOROVOD_TIMELINE_MARK_CYCLES analog)."""
+    if _active and _mark_cycles:
+        record("negotiation", "CYCLE", PHASE_INSTANT)
 
 
 def stop_timeline() -> None:
@@ -100,6 +113,42 @@ def record(tensor: str, activity: str, phase: int) -> None:
         eng.timeline_record(tensor, activity, phase)
 
 
+def merge_timelines(inputs, output: str) -> int:
+    """Merge per-process timeline files into one Chrome trace, one pid per
+    process (the reference writes a single coordinator-side file,
+    ``timeline.cc``; the symmetric rebuild writes per-process files and
+    merges after the run). Input order assigns pids; files named
+    ``<base>.<rank>`` (the ``maybe_autostart`` convention) are labeled with
+    their rank. Returns the number of events written.
+
+    Also usable as a CLI: ``python -m horovod_tpu.timeline merged.json
+    trace.0 trace.1 ...``.
+    """
+    import json
+    import os
+    import re
+
+    events = []
+    for i, path in enumerate(inputs):
+        m = re.search(r"\.(\d+)$", os.path.basename(path))
+        pid = int(m.group(1)) if m else i
+        text = open(path).read().strip()
+        # the writer appends events incrementally; tolerate a missing
+        # closing bracket / trailing comma (Chrome's own loader does)
+        text = text.rstrip(",\n ")
+        if not text.endswith("]"):
+            text += "]"
+        for ev in json.loads(text):
+            ev["pid"] = pid
+            events.append(ev)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"rank {pid}"}})
+    events.sort(key=lambda e: e.get("ts", 0))
+    with open(output, "w") as f:
+        json.dump(events, f)
+    return len(events)
+
+
 class op_range:
     """Context manager tracing one eager collective: begin/end records in
     the Chrome timeline plus a ``jax.profiler.TraceAnnotation`` range so
@@ -131,3 +180,13 @@ class op_range:
         if _active:
             record(self.tensor, self.activity, PHASE_END)
         return False
+
+
+if __name__ == "__main__":  # pragma: no cover - thin CLI
+    import sys
+    if len(sys.argv) < 3:
+        print("usage: python -m horovod_tpu.timeline OUT.json IN.0 [IN.1 ...]",
+              file=sys.stderr)
+        raise SystemExit(2)
+    n = merge_timelines(sys.argv[2:], sys.argv[1])
+    print(f"merged {len(sys.argv) - 2} timelines ({n} events) -> {sys.argv[1]}")

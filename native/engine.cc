@@ -204,7 +204,9 @@ class Engine {
   int32_t enqueue(const char* name, int32_t request_type, int32_t dtype,
                   int32_t element_size, const int64_t* shape, int32_t ndim,
                   int32_t root_rank, int32_t group_id,
-                  const int32_t* splits, int32_t nsplits) {
+                  const int32_t* splits, int32_t nsplits,
+                  int32_t reduce_op, double prescale, double postscale,
+                  int32_t splits_crc) {
     std::lock_guard<std::mutex> lock(mu_);
     std::string key(name);
     if (outstanding_.count(key)) return -1;  // duplicate name still in flight
@@ -215,6 +217,10 @@ class Engine {
     q.element_size = element_size;
     q.root_rank = root_rank;
     q.group_id = group_id;
+    q.reduce_op = reduce_op;
+    q.prescale = prescale;
+    q.postscale = postscale;
+    q.splits_crc = splits_crc;
     q.name = std::move(key);
     q.shape.assign(shape, shape + ndim);
     if (splits != nullptr && nsplits > 0) q.splits.assign(splits, splits + nsplits);
@@ -313,9 +319,16 @@ class Engine {
     for (auto& q : list.requests) {
       if (q.type == RequestType::JOIN) {
         joined_ranks_.insert(rank);
+        join_names_.insert(q.name);
+        last_joined_rank_ = rank;  // rank-ordered ingest: deterministic
         join_pending_ = true;
         continue;
       }
+      /* Served this cycle from the cache (commit runs pre-ingest under
+       * the batched transport): the request is already satisfied — do not
+       * grow a table entry for it. Identical served sets everywhere keep
+       * this symmetric. */
+      if (served_this_cycle_.count(q.name)) continue;
       /* Cache invalidation must be driven by the globally-ingested request
        * stream, not by this rank's local inflight set: every rank ingests
        * the identical rank-ordered lists, so erases happen on the same
@@ -373,6 +386,7 @@ class Engine {
   int32_t commit_cache_bits(const uint8_t* bits, size_t len) {
     std::lock_guard<std::mutex> lock(mu_);
     cache_hits_this_cycle_.clear();
+    served_this_cycle_.clear();
     std::vector<std::string> served;
     for (auto& kv : local_inflight_) {
       const Request& q = kv.second;
@@ -400,10 +414,15 @@ class Engine {
       cache_.touch(name);
       complete(name);
       /* A cache-served tensor must not also be scheduled from the
-       * negotiation table (its requests were ingested this cycle like
-       * everyone else's). The served set is identical on every rank (AND
-       * of identical bit layouts), so table erases stay consistent. */
+       * negotiation table. Commit now runs BEFORE ingest (batched one-
+       * round transport: bits are computed against the pre-ingest cache
+       * state so bit positions agree on every rank), so this erase covers
+       * prior-cycle entries and served_this_cycle_ makes ingest skip this
+       * cycle's requests for served names. The served set is identical on
+       * every rank (AND of identical bit layouts), so both stay
+       * consistent. */
       table_.erase(name);
+      served_this_cycle_.insert(name);
     }
     return 0;
   }
@@ -462,13 +481,19 @@ class Engine {
     fuse(schedulable, result);
     for (auto& err : errors) result.responses.push_back(std::move(err));
 
-    // JOIN: emitted only when every rank joined (controller.cc:268-272)
+    // JOIN: emitted only when every rank joined (controller.cc:268-272);
+    // root_rank carries the last joined rank (the reference's
+    // output_last_joined_rank, operations.cc:1729-1761)
     if (join_pending_ &&
         joined_ranks_.size() == static_cast<size_t>(world_size_)) {
       Response j;
       j.type = ResponseType::JOIN;
+      j.root_rank = last_joined_rank_;
+      j.tensor_names.assign(join_names_.begin(), join_names_.end());
+      for (const auto& n : j.tensor_names) complete(n);
       result.responses.push_back(std::move(j));
       joined_ranks_.clear();
+      join_names_.clear();
       join_pending_ = false;
     }
 
@@ -482,6 +507,13 @@ class Engine {
         proto.root_rank = e->first.root_rank;
         proto.total_bytes = e->first.byte_size();
         proto.tensor_names = {e->first.name};
+        /* joined-rank zero reconstruction must work from cache-served
+         * responses too */
+        proto.shapes = {e->first.shape};
+        proto.group_ids = {e->first.group_id};
+        proto.reduce_op = e->first.reduce_op;
+        proto.prescale = e->first.prescale;
+        proto.postscale = e->first.postscale;
         cache_.put(e->first, proto);
       }
     }
@@ -639,6 +671,29 @@ class Engine {
          << " used root " << q.root_rank << " for tensor " << e.first.name
          << ".";
       e.error_message = os.str();
+      return;
+    }
+    if (q.type == RequestType::ALLTOALL && q.splits_crc != 0 &&
+        e.first.splits_crc != 0 && q.splits_crc != e.first.splits_crc) {
+      os << "Mismatched alltoall splits matrices for tensor " << e.first.name
+         << ": rank " << e.first_rank << " and rank " << rank
+         << " derived their splits rows from different matrices.";
+      e.error_message = os.str();
+      return;
+    }
+    bool reduce_like = q.type == RequestType::ALLREDUCE ||
+                       q.type == RequestType::ADASUM ||
+                       q.type == RequestType::REDUCESCATTER;
+    if (reduce_like && (q.reduce_op != e.first.reduce_op ||
+                        q.prescale != e.first.prescale ||
+                        q.postscale != e.first.postscale)) {
+      os << "Mismatched reduce parameters for tensor " << e.first.name
+         << ": rank " << e.first_rank << " used (op=" << e.first.reduce_op
+         << ", prescale=" << e.first.prescale << ", postscale="
+         << e.first.postscale << ") while rank " << rank << " used (op="
+         << q.reduce_op << ", prescale=" << q.prescale << ", postscale="
+         << q.postscale << ").";
+      e.error_message = os.str();
     }
   }
 
@@ -670,6 +725,11 @@ class Engine {
         r.root_rank = q.root_rank;
         r.total_bytes = bytes;
         r.tensor_names = {q.name};
+        r.shapes = {q.shape};
+        r.group_ids = {q.group_id};
+        r.reduce_op = q.reduce_op;
+        r.prescale = q.prescale;
+        r.postscale = q.postscale;
         if (q.type == RequestType::ALLTOALL) {
           /* Negotiated recv-splits for this engine's rank: rank j sends us
            * splits_j[rank_] rows (its even share when it sent no splits) —
@@ -694,9 +754,14 @@ class Engine {
       bool joinable = open && current.type == rtype &&
                       current.dtype == q.dtype &&
                       current.root_rank == q.root_rank &&
+                      current.reduce_op == q.reduce_op &&
+                      current.prescale == q.prescale &&
+                      current.postscale == q.postscale &&
                       current.total_bytes + bytes <= fusion_threshold_;
       if (joinable) {
         current.tensor_names.push_back(q.name);
+        current.shapes.push_back(q.shape);
+        current.group_ids.push_back(q.group_id);
         current.total_bytes += bytes;
       } else {
         flush();
@@ -704,8 +769,13 @@ class Engine {
         current.type = rtype;
         current.dtype = q.dtype;
         current.root_rank = q.root_rank;
+        current.reduce_op = q.reduce_op;
+        current.prescale = q.prescale;
+        current.postscale = q.postscale;
         current.total_bytes = bytes;
         current.tensor_names = {q.name};
+        current.shapes = {q.shape};
+        current.group_ids = {q.group_id};
         open = true;
       }
     }
@@ -735,10 +805,13 @@ class Engine {
 
   std::mutex mu_;
   std::vector<Request> pending_;
+  std::set<std::string> served_this_cycle_;
   std::set<std::string> outstanding_;
   std::unordered_map<std::string, Request> local_inflight_;
   std::map<std::string, TableEntry> table_;
   std::set<int32_t> joined_ranks_;
+  std::set<std::string> join_names_;
+  int32_t last_joined_rank_ = -1;
   bool join_pending_ = false;
   uint64_t next_sequence_ = 0;
   std::map<int32_t, size_t> group_member_counts_;
@@ -774,10 +847,12 @@ int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
                            int32_t request_type, int32_t dtype,
                            int32_t element_size, const int64_t* shape,
                            int32_t ndim, int32_t root_rank, int32_t group_id,
-                           const int32_t* splits, int32_t nsplits) {
+                           const int32_t* splits, int32_t nsplits,
+                           int32_t reduce_op, double prescale,
+                           double postscale, int32_t splits_crc) {
   return static_cast<hvd::Engine*>(engine)->enqueue(
       name, request_type, dtype, element_size, shape, ndim, root_rank,
-      group_id, splits, nsplits);
+      group_id, splits, nsplits, reduce_op, prescale, postscale, splits_crc);
 }
 
 int32_t hvd_engine_pop_requests(hvd_engine_t engine, const uint8_t** out,

@@ -81,11 +81,16 @@ void hvd_engine_destroy(hvd_engine_t engine);
  * duplicate name still pending (common.h:229-232), -2 when a
  * post-abandon retry's metadata differs from the in-flight negotiation,
  * or -3 on invalid splits (wrong length, negative, sum > dim0). */
+/* reduce_op/prescale/postscale: wire-lowered reduce parameters for the
+ * ALLREDUCE family — validated for cross-rank agreement and echoed on the
+ * response so a JOINed rank can reconstruct the identical program. */
 int32_t hvd_engine_enqueue(hvd_engine_t engine, const char* name,
                            int32_t request_type, int32_t dtype,
                            int32_t element_size, const int64_t* shape,
                            int32_t ndim, int32_t root_rank, int32_t group_id,
-                           const int32_t* splits, int32_t nsplits);
+                           const int32_t* splits, int32_t nsplits,
+                           int32_t reduce_op, double prescale,
+                           double postscale, int32_t splits_crc);
 
 /* Serialize and clear this rank's pending requests (the per-cycle
  * PopMessagesFromQueue, controller.cc:92). */

@@ -68,6 +68,19 @@ struct Request {
    * (the reference's uneven-splits metadata, operations.cc:1691-1717).
    * Empty = even splits. */
   std::vector<int32_t> splits;
+  /* ALLREDUCE family: wire-lowered reduce op + scale factors (the
+   * reference Request carries prescale/postscale too, message.h). Checked
+   * for cross-rank agreement and echoed on the response so a JOINed rank
+   * can reconstruct the identical SPMD program with zero inputs. */
+  int32_t reduce_op = -1;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  /* ALLTOALL: digest of the caller's FULL splits matrix (0 = not
+   * supplied). Rows legitimately differ per rank, but the matrix every
+   * rank derived its row from must be identical — a mismatch must fail on
+   * EVERY rank (symmetric ERROR), never hang the subset whose columns
+   * happen to agree. */
+  int32_t splits_crc = 0;
 
   int64_t num_elements() const {
     int64_t n = 1;
@@ -88,6 +101,10 @@ struct Request {
     for (int64_t d : shape) w.i64(d);
     w.u32(static_cast<uint32_t>(splits.size()));
     for (int32_t s : splits) w.i32(s);
+    w.i32(reduce_op);
+    w.f64(prescale);
+    w.f64(postscale);
+    w.i32(splits_crc);
   }
 
   static Request parse(Reader& r) {
@@ -105,6 +122,10 @@ struct Request {
     uint32_t ns = r.u32();
     q.splits.resize(ns);
     for (uint32_t i = 0; i < ns; ++i) q.splits[i] = r.i32();
+    q.reduce_op = r.i32();
+    q.prescale = r.f64();
+    q.postscale = r.f64();
+    q.splits_crc = r.i32();
     return q;
   }
 };
@@ -138,6 +159,15 @@ struct Response {
    * Controller::AlltoallGetRecvSplits (collective_operations.h:219-221).
    * The one rank-dependent response field (each engine computes its own). */
   std::vector<int32_t> recv_splits;
+  /* Per-tensor metadata (aligned with tensor_names) + reduce parameters so
+   * a JOINed rank can reconstruct and execute the exact same SPMD program
+   * with zero inputs (the reference's JoinOp allocates zero buffers from
+   * response metadata, collective_operations.h:275-290). */
+  std::vector<std::vector<int64_t>> shapes;
+  std::vector<int32_t> group_ids;
+  int32_t reduce_op = -1;
+  double prescale = 1.0;
+  double postscale = 1.0;
 
   void serialize(Writer& w) const {
     w.u8(static_cast<uint8_t>(type));
@@ -150,6 +180,16 @@ struct Response {
     for (const auto& n : tensor_names) w.str(n);
     w.u32(static_cast<uint32_t>(recv_splits.size()));
     for (int32_t s : recv_splits) w.i32(s);
+    w.u32(static_cast<uint32_t>(shapes.size()));
+    for (const auto& shp : shapes) {
+      w.u32(static_cast<uint32_t>(shp.size()));
+      for (int64_t d : shp) w.i64(d);
+    }
+    w.u32(static_cast<uint32_t>(group_ids.size()));
+    for (int32_t g : group_ids) w.i32(g);
+    w.i32(reduce_op);
+    w.f64(prescale);
+    w.f64(postscale);
   }
   static Response parse(Reader& r) {
     Response s;
@@ -165,6 +205,19 @@ struct Response {
     uint32_t ns = r.u32();
     s.recv_splits.resize(ns);
     for (uint32_t i = 0; i < ns; ++i) s.recv_splits[i] = r.i32();
+    uint32_t nsh = r.u32();
+    s.shapes.resize(nsh);
+    for (uint32_t i = 0; i < nsh; ++i) {
+      uint32_t nd = r.u32();
+      s.shapes[i].resize(nd);
+      for (uint32_t j = 0; j < nd; ++j) s.shapes[i][j] = r.i64();
+    }
+    uint32_t ng = r.u32();
+    s.group_ids.resize(ng);
+    for (uint32_t i = 0; i < ng; ++i) s.group_ids[i] = r.i32();
+    s.reduce_op = r.i32();
+    s.prescale = r.f64();
+    s.postscale = r.f64();
     return s;
   }
 };

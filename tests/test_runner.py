@@ -237,3 +237,35 @@ def test_hvdrun_cli_failure_exit_code(tmp_path):
     code = run_commandline(
         ["-np", "2", "--", sys.executable, "-c", "import sys; sys.exit(3)"])
     assert code == 3
+
+
+def test_kv_gather_endpoint():
+    """Server-side long-poll gather: one round trip collects a scope."""
+    from horovod_tpu.runner.http_kv import KVClient, KVServer, make_secret
+    import threading as _threading
+    import time as _time
+
+    secret = make_secret()
+    server = KVServer(secret=secret)
+    port = server.start()
+    client = KVClient("127.0.0.1", port, secret=secret)
+    try:
+        client.put("g/0", b"a" * 10)
+        client.put("g/2", b"c")
+
+        def late_put():
+            _time.sleep(0.2)
+            client2 = KVClient("127.0.0.1", port, secret=secret)
+            client2.put("g/1", b"bb")
+
+        t = _threading.Thread(target=late_put)
+        t.start()
+        got = client.gather("g", 3, timeout=10)
+        t.join()
+        assert got == {"g/0": b"a" * 10, "g/1": b"bb", "g/2": b"c"}
+        # timeout path
+        import pytest as _pytest
+        with _pytest.raises(TimeoutError):
+            client.gather("nothing", 2, timeout=0.3)
+    finally:
+        server.stop()

@@ -101,3 +101,41 @@ class TestLauncherTimeline:
         assert os.path.exists(trace_path), proc.stdout
         events = _load(trace_path)
         assert any(e.get("cat") == "step_grads" for e in events)
+
+
+def test_merge_timelines(tmp_path):
+    import json
+    from horovod_tpu.timeline import merge_timelines
+
+    for r in (0, 1):
+        (tmp_path / f"trace.{r}").write_text(
+            '[{"name": "ALLREDUCE", "cat": "g", "ph": "B", "ts": %d, '
+            '"pid": 0, "tid": 0},\n' % (100 + r))  # unterminated, like a live file
+    out = tmp_path / "merged.json"
+    n = merge_timelines([str(tmp_path / "trace.0"), str(tmp_path / "trace.1")],
+                        str(out))
+    events = json.loads(out.read_text())
+    assert n == len(events) == 4  # 2 events + 2 process_name metadata
+    pids = {e["pid"] for e in events if e.get("name") == "ALLREDUCE"}
+    assert pids == {0, 1}
+
+
+def test_mark_cycles_records_instants(tmp_path):
+    import json
+    import horovod_tpu as hvd
+    from horovod_tpu import timeline
+
+    path = tmp_path / "cycles.json"
+    hvd.start_timeline(str(path), mark_cycles=True)
+    try:
+        timeline.mark_cycle()
+        timeline.mark_cycle()
+    finally:
+        hvd.stop_timeline()
+    text = path.read_text().rstrip(",\n ")
+    if not text.endswith("]"):
+        text += "]"
+    events = json.loads(text)
+    cycles = [e for e in events if e.get("name") == "CYCLE"]
+    assert len(cycles) == 2
+    assert all(e["ph"] == "i" for e in cycles)
