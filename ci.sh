@@ -40,15 +40,14 @@ EOF
 step "3/4 driver artifact: multi-chip dryrun (8 virtual devices)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
 
-step "4/4 example smoke runs (np=2, like gen-pipeline.sh:160-290)"
-if [ -d examples ]; then
-  for ex in examples/*.py; do
-    echo "--- $ex"
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python "$ex" --smoke || fail=1
-  done
-else
-  echo "(no examples/ yet)"
-fi
+step "4/4 example smoke runs (single-process 8-dev mesh + np=2 hvdrun, like gen-pipeline.sh:160-290)"
+for ex in examples/*.py; do
+  echo "--- $ex (1 process, 8 virtual devices)"
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python "$ex" --smoke || fail=1
+done
+echo "--- examples/mnist.py (hvdrun -np 2)"
+env -u XLA_FLAGS python -m horovod_tpu.runner.launch -np 2 -- \
+  python examples/mnist.py --smoke || fail=1
 
 exit $fail

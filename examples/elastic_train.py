@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Elastic training example — the TPU-native mirror of the reference's
+``examples/elastic/pytorch/pytorch_mnist_elastic.py``: state
+commit/restore with ``hvd.elastic.run``, an :class:`ElasticSampler`
+re-partitioning the remaining epoch after membership changes.
+
+Single-process smoke (no driver — the recovery loop still runs):
+    python examples/elastic_train.py --smoke
+
+Real elastic launch:
+    python -m horovod_tpu.runner.launch -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh -- \
+        python examples/elastic_train.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-per-rank", type=int, default=8)
+    parser.add_argument("--smoke", action="store_true")
+    args = parser.parse_args()
+    if args.smoke:
+        args.epochs = 2
+
+    hvd.init()
+
+    rng = np.random.default_rng(0)
+    data_x = rng.standard_normal((256, 16)).astype(np.float32)
+    true_w = rng.standard_normal((16, 1)).astype(np.float32)
+    data_y = data_x @ true_w
+
+    params = {"w": jnp.zeros((16, 1), jnp.float32)}
+    tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+    sampler = hvd.elastic.ElasticSampler(len(data_x), seed=1)
+
+    state = hvd.elastic.JaxState(
+        params=params, opt_state=tx.init(params),
+        sampler=sampler.state_dict(), epoch=0, losses=[])
+    state.register_reset_callbacks(
+        [lambda: sampler.load_state_dict(state.sampler)])
+
+    def train_step_fn(mesh, axis):
+        def train_step(params, opt_state, x, y):
+            def loss_fn(p):
+                return jnp.mean((x @ p["w"] - y) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+        return jax.jit(jax.shard_map(
+            train_step, mesh=mesh, in_specs=(P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P()), check_vma=False))
+
+    @hvd.elastic.run
+    def train(state):
+        # (re)compiled per membership: the mesh changes when the world does
+        mesh, axis = hvd.mesh(), hvd.axis_name()
+        step = train_step_fn(mesh, axis)
+        sharding = NamedSharding(mesh, P(axis))
+        n = hvd.size()
+        batch = args.batch_per_rank * n
+        for state.epoch in range(state.epoch, args.epochs):
+            idx_all = sampler.local_indices()
+            for start in range(0, len(idx_all) - args.batch_per_rank + 1,
+                               args.batch_per_rank):
+                # every rank takes its own slice; globally the batch covers
+                # `batch` distinct samples
+                local = idx_all[start:start + args.batch_per_rank]
+                gx = np.concatenate(
+                    [data_x[local]] * n) if n > 1 else data_x[local]
+                gy = np.concatenate(
+                    [data_y[local]] * n) if n > 1 else data_y[local]
+                x = jax.device_put(gx[:batch], sharding)
+                y = jax.device_put(gy[:batch], sharding)
+                state.params, state.opt_state, loss = step(
+                    state.params, state.opt_state, x, y)
+                sampler.record_batch(args.batch_per_rank)
+                state.sampler = sampler.state_dict()
+                state.losses = state.losses + [
+                    float(jax.block_until_ready(loss))]
+                state.commit()
+            sampler.set_epoch(state.epoch + 1)
+            state.sampler = sampler.state_dict()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={state.losses[-1]:.5f}")
+        return state.losses
+
+    losses = train(state)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    if hvd.rank() == 0:
+        print("OK")
+
+
+if __name__ == "__main__":
+    main()

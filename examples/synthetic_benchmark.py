@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Synthetic throughput benchmark — the TPU-native mirror of the
+reference's ``examples/tensorflow2/tensorflow2_synthetic_benchmark.py``
+(ResNet-50 on synthetic ImageNet batches, DistributedGradientTape,
+``--fp16-allreduce``). The repo-root ``bench.py`` is the driver-facing
+variant with MFU accounting; this example shows the user-facing recipe.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/synthetic_benchmark.py --model ResNet18 \
+        --image-size 32 --batch-size 16 --num-iters 3
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import models as hvd_models
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="ResNet50",
+                        choices=["ResNet18", "ResNet34", "ResNet50",
+                                 "ResNet101", "ResNet152"])
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-chip batch size (reference default)")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-warmup", type=int, default=2)
+    parser.add_argument("--fp16-allreduce", action="store_true",
+                        help="compress gradients on the wire (reference "
+                             "--fp16-allreduce)")
+    parser.add_argument("--smoke", action="store_true")
+    args = parser.parse_args()
+    if args.smoke:
+        args.model, args.image_size = "ResNet18", 32
+        args.batch_size, args.num_iters, args.num_warmup = 4, 2, 1
+
+    hvd.init()
+    n = hvd.size()
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+
+    model_cls = getattr(hvd_models, args.model)
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16, axis_name=None)
+    s = args.image_size
+    images = np.random.default_rng(0).standard_normal(
+        (n * args.batch_size, s, s, 3), dtype=np.float32)
+    labels = np.random.default_rng(1).integers(
+        0, 1000, size=(n * args.batch_size,))
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, s, s, 3)), train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  compression=compression)
+    opt_state = tx.init(params)
+
+    def train_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(y, 1000)
+            loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), -1))
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P(), P(), P()), check_vma=False))
+
+    data_sharding = NamedSharding(mesh, P(axis))
+    x = jax.device_put(images, data_sharding)
+    y = jax.device_put(labels, data_sharding)
+
+    for _ in range(args.num_warmup):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+    jax.block_until_ready((params, loss))
+    elapsed = time.perf_counter() - t0
+
+    img_sec = args.num_iters * args.batch_size * n / elapsed
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch {args.batch_size}/chip, "
+              f"{n} chips")
+        print(f"Total img/sec on {n} chip(s): {img_sec:.1f} "
+              f"({img_sec / n:.1f} per chip)")
+        print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,8 +98,21 @@ from .functions import (
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from .timeline import start_timeline, stop_timeline
 from . import autotune
+from . import callbacks
+from . import checkpoint
+from . import data
 from . import elastic
+from .callbacks import average_metrics, metric_average
 from .version import __version__
+
+
+def __getattr__(name):
+    # lazy: pulls in flax model definitions only when actually used, so
+    # plain `import horovod_tpu` (launcher, runner utilities) stays light
+    if name == "SyncBatchNorm":
+        from .models.sync_batch_norm import SyncBatchNorm
+        return SyncBatchNorm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # Torch-parity aliases (reference exposes in-place variants; jax arrays are
 # immutable so they alias the pure versions).
@@ -124,5 +137,7 @@ __all__ = [
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
-    "start_timeline", "stop_timeline", "autotune", "elastic", "__version__",
+    "start_timeline", "stop_timeline", "autotune", "callbacks",
+    "checkpoint", "data", "elastic", "average_metrics", "metric_average",
+    "SyncBatchNorm", "__version__",
 ]

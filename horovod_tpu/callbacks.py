@@ -1,0 +1,96 @@
+"""Training-loop helpers: LR scaling/warmup schedules and metric averaging.
+
+TPU-native rebuild of the reference's Keras callbacks
+(``/root/reference/horovod/_keras/callbacks.py:1-493``). Keras mutates the
+optimizer's ``lr`` variable from callback hooks; the optax idiom is a
+*schedule* — a pure ``fn(step) -> lr`` passed to the optimizer once — so
+each callback maps to a schedule factory:
+
+* ``LearningRateScheduleCallback``  → :func:`lr_schedule`
+* ``LearningRateWarmupCallback``    → :func:`warmup_schedule`
+* ``MetricAverageCallback``         → :func:`metric_average` / :func:`average_metrics`
+* ``BroadcastGlobalVariablesCallback`` → ``hvd.broadcast_parameters``
+  (call once before step 0; already in :mod:`horovod_tpu.functions`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import jax.numpy as jnp
+
+from . import runtime
+from .ops import collectives
+from .ops.reduce_ops import ReduceOp
+
+
+def lr_schedule(initial_lr: float, multiplier, *, steps_per_epoch: int,
+                start_epoch: int = 0, end_epoch: int | None = None,
+                staircase: bool = True) -> Callable:
+    """Epoch-indexed learning-rate schedule (reference
+    ``LearningRateScheduleCallbackImpl``, ``_keras/callbacks.py:96-180``).
+
+    ``multiplier`` is ``fn(epoch) -> factor`` or a constant (then it decays
+    exponentially: ``multiplier ** (epoch - start_epoch)``, matching the
+    reference). ``staircase`` applies the multiplier per epoch; otherwise
+    per step with a fractional epoch. Outside [start_epoch, end_epoch) the
+    lr stays ``initial_lr``.
+    """
+    if not callable(multiplier):
+        factor = float(multiplier)
+
+        def multiplier(epoch):  # noqa: F811 - reference semantics
+            return factor ** (epoch - start_epoch)
+
+    def schedule(step):
+        epoch = step / steps_per_epoch
+        if staircase:
+            epoch = jnp.floor(epoch)
+        in_range = epoch >= start_epoch
+        if end_epoch is not None:
+            in_range = jnp.logical_and(in_range, epoch < end_epoch)
+        return jnp.where(in_range, initial_lr * multiplier(epoch),
+                         initial_lr)
+
+    return schedule
+
+
+def warmup_schedule(initial_lr: float, *, steps_per_epoch: int,
+                    warmup_epochs: float = 5,
+                    size: int | None = None) -> Callable:
+    """Gradual learning-rate warmup (reference
+    ``LearningRateWarmupCallbackImpl``, ``_keras/callbacks.py:182-250``,
+    after Goyal et al. 2017): ramps linearly from ``initial_lr / size`` to
+    ``initial_lr`` over ``warmup_epochs``. ``initial_lr`` is the already
+    size-scaled target rate, exactly like the reference's usage
+    ``lr=base_lr * hvd.size()``.
+    """
+    n = runtime.size() if size is None else size
+
+    def multiplier(epoch):
+        # same fractional-epoch adjustment as the reference so the ramp
+        # ends exactly on the epoch boundary
+        epoch = epoch + 1.0 / steps_per_epoch
+        return 1.0 / n * (epoch * (n - 1) / warmup_epochs + 1)
+
+    return lr_schedule(initial_lr, multiplier,
+                       steps_per_epoch=steps_per_epoch, start_epoch=0,
+                       end_epoch=warmup_epochs, staircase=False)
+
+
+def metric_average(value, name: str | None = None, *, process_set=None):
+    """Average a scalar metric over all ranks (the reference's per-metric
+    ``hvd.allreduce`` inside ``MetricAverageCallbackImpl``). Eager — call
+    it outside jit at epoch end."""
+    out = collectives.allreduce(jnp.asarray(value, jnp.float32),
+                                op=ReduceOp.AVERAGE, name=name,
+                                process_set=process_set)
+    return float(out)
+
+
+def average_metrics(logs: Mapping, *, process_set=None) -> dict:
+    """Average every value of a metrics dict across ranks, sorted by key
+    for deterministic collective order on every rank (reference
+    ``_average_metrics_in_place``, ``_keras/callbacks.py:69-88``)."""
+    return {k: metric_average(v, name=f"metric.{k}", process_set=process_set)
+            for k, v in sorted(logs.items())}

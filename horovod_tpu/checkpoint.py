@@ -1,0 +1,126 @@
+"""Persistent (on-disk / cloud) checkpointing of sharded training state.
+
+The reference has no general checkpoint subsystem — its three scoped
+mechanisms (SURVEY.md §5.4) are the in-memory elastic ``State``
+commit/restore, init-time ``broadcast_parameters``, and the Spark
+estimators' ``Store`` persisting model state between epochs
+(``/root/reference/horovod/spark/common/store.py:1-582``, HDFS/S3/local
+backends). This module is the TPU-native unification SURVEY §5.4 calls
+for: orbax-backed checkpoints of sharded jax pytrees, usable standalone or
+as the durable layer under elastic training (commit to memory every few
+steps, checkpoint to disk every epoch; after a full job restart,
+``restore`` + ``hvd.broadcast_parameters`` resumes).
+
+    import horovod_tpu as hvd
+    mgr = hvd.checkpoint.Checkpointer("/ckpts/run1", max_to_keep=3)
+    mgr.save(step, {"params": params, "opt_state": opt_state})
+    ...
+    state = mgr.restore(target={"params": params0, "opt_state": opt0})
+
+Orbax writes each shard from the process that owns it (the multi-host
+path), supports local paths and ``gs://`` buckets (via tensorstore), and
+restores arrays with the shardings of the ``target`` template — the
+mechanics the Spark ``Store`` delegates to HDFS clients.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from .utils import logging as hvd_logging
+
+
+class Checkpointer:
+    """Step-indexed checkpoint directory with retention (the orbax
+    ``CheckpointManager`` wrapped in the framework's conventions)."""
+
+    def __init__(self, directory: str, *, max_to_keep: int | None = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory) \
+            if "://" not in directory else directory
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    # -- save / restore ----------------------------------------------------
+
+    def save(self, step: int, tree: Any, *, wait: bool = False) -> None:
+        """Save a pytree of (possibly sharded) arrays at ``step``. Every
+        process must call this (each writes its own shards). Async by
+        default; ``wait=True`` blocks until durable."""
+        self._mgr.save(int(step),
+                       args=self._ocp.args.StandardSave(tree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, *, step: int | None = None, target: Any = None) -> Any:
+        """Restore the pytree saved at ``step`` (default: latest). With a
+        ``target`` template, arrays come back with the template leaves'
+        shardings/dtypes — pass your freshly-initialized state so restored
+        arrays land directly on the mesh."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}")
+        if target is not None:
+            args = self._ocp.args.StandardRestore(target)
+        else:
+            args = self._ocp.args.StandardRestore()
+        return self._mgr.restore(int(step), args=args)
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list:
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        try:
+            self._mgr.wait_until_finished()
+        finally:
+            self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def save(directory: str, step: int, tree: Any) -> None:
+    """One-shot save (epoch-end Spark ``Store`` idiom)."""
+    with Checkpointer(directory, max_to_keep=None) as mgr:
+        mgr.save(step, tree, wait=True)
+
+
+def restore(directory: str, *, step: int | None = None,
+            target: Any = None) -> Any:
+    """One-shot restore of ``step`` (default latest)."""
+    with Checkpointer(directory) as mgr:
+        return mgr.restore(step=step, target=target)
+
+
+def restore_or_none(directory: str, *, target: Any = None) -> Any | None:
+    """Restore the latest checkpoint, or None when the directory has none
+    (the resume-if-present idiom)."""
+    try:
+        with Checkpointer(directory) as mgr:
+            if mgr.latest_step() is None:
+                return None
+            return mgr.restore(target=target)
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        hvd_logging.warning("checkpoint restore from %s failed: %s",
+                            directory, e)
+        return None

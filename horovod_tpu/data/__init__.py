@@ -1,0 +1,11 @@
+"""Data loading utilities.
+
+TPU-native rebuild of ``/root/reference/horovod/data/data_loader_base.py:1-165``:
+a minimal loader interface plus an async mixin that prefetches batches on a
+background thread so host-side input work overlaps device steps (on TPU
+this hides host→HBM transfer and numpy batch assembly behind the MXU).
+"""
+
+from .loader import AsyncDataLoaderMixin, BaseDataLoader, ShardedArrayLoader
+
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "ShardedArrayLoader"]

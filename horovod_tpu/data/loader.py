@@ -1,0 +1,168 @@
+"""Loader base + async prefetch mixin + a sharded-array loader.
+
+Reference: ``/root/reference/horovod/data/data_loader_base.py:1-165``
+(``BaseDataLoader`` interface; ``AsyncDataLoaderMixin`` with a daemon
+thread pushing batches into a bounded queue). The rebuild keeps the same
+composition pattern::
+
+    class MyAsyncLoader(AsyncDataLoaderMixin, MyLoader):
+        pass
+
+and adds :class:`ShardedArrayLoader` — the jax-idiomatic concrete loader
+that shards each batch across the mesh's data axis with one
+``device_put`` so a jitted SPMD step consumes it directly.
+"""
+
+from __future__ import annotations
+
+from queue import Empty, Queue
+from threading import Event, Thread
+
+
+class BaseDataLoader:
+    """Iterable of batches (reference ``BaseDataLoader``)."""
+
+    def __len__(self):
+        raise NotImplementedError()
+
+    def _iterate(self):
+        """Yield raw batches; implemented by concrete loaders."""
+        raise NotImplementedError()
+
+    def __iter__(self):
+        for batch in self._iterate():
+            yield self._process_batch(batch)
+
+    def _process_batch(self, batch):
+        """Hook for subclass/trainer batch post-processing."""
+        return batch
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch ``_iterate()`` on a daemon thread into a bounded queue
+    (reference ``AsyncDataLoaderMixin``; queue size 0 disables async).
+
+    Mix in FIRST: ``class Loader(AsyncDataLoaderMixin, Base)``.
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 64, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        super().__init__(*args, **kwargs)
+        self._queue: Queue | None = None
+        self._finished: Event | None = None
+        self._thread: Thread | None = None
+
+    def close_async_loader(self) -> None:
+        """Stop the prefetch thread and drain the queue."""
+        if self._thread is None:
+            return
+        self._finished.set()
+        while True:  # unblock a producer waiting on a full queue
+            try:
+                self._queue.get_nowait()
+            except Empty:
+                break
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    def _async_worker(self):
+        try:
+            for batch in super().__iter__():
+                if self._finished.is_set():
+                    return
+                self._queue.put((batch, None))
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            # a producer error must surface in the training loop, not die
+            # silently in the daemon thread (a truncated epoch on one rank
+            # deadlocks the next collective)
+            self._queue.put((None, e))
+            return
+        self._queue.put((None, None))  # end-of-epoch sentinel
+
+    def __iter__(self):
+        if self.async_loader_queue_size <= 0:
+            yield from super().__iter__()
+            return
+        self._finished = Event()
+        self._queue = Queue(self.async_loader_queue_size)
+        self._thread = Thread(target=self._async_worker, daemon=True,
+                              name="hvd-data-prefetch")
+        self._thread.start()
+        try:
+            while True:
+                batch, error = self._queue.get()
+                if error is not None:
+                    raise error
+                if batch is None:
+                    break
+                yield batch
+        finally:
+            self.close_async_loader()
+
+
+class ShardedArrayLoader(BaseDataLoader):
+    """Batches of host arrays, sharded over the mesh's data axis.
+
+    Each yielded batch is a tuple of jax arrays with
+    ``NamedSharding(hvd.mesh(), P(hvd.axis_name()))`` — ready for a
+    ``shard_map``/``pjit`` step. The global batch size must divide by the
+    world size; the trailing remainder of an epoch is dropped (like the
+    reference's distributed samplers pad/drop to keep ranks aligned).
+    """
+
+    def __init__(self, *arrays, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, drop_remainder: bool = True):
+        import numpy as np
+
+        if not arrays:
+            raise ValueError("ShardedArrayLoader needs at least one array")
+        n = len(arrays[0])
+        if any(len(a) != n for a in arrays):
+            raise ValueError("all arrays must share the leading dimension")
+        self.arrays = [np.asarray(a) for a in arrays]
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __len__(self):
+        n = len(self.arrays[0])
+        return n // self.batch_size if self.drop_remainder else \
+            -(-n // self.batch_size)
+
+    def _iterate(self):
+        import numpy as np
+
+        from .. import runtime
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import jax
+
+        n = len(self.arrays[0])
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(order)
+        sharding = None
+        if runtime.is_initialized():
+            sharding = NamedSharding(runtime.mesh(), P(runtime.axis_name()))
+            if self.batch_size % runtime.size() != 0:
+                raise ValueError(
+                    f"batch_size {self.batch_size} must divide by the "
+                    f"world size {runtime.size()}")
+            if not self.drop_remainder and n % self.batch_size \
+                    and (n % self.batch_size) % runtime.size():
+                raise ValueError(
+                    f"drop_remainder=False with a trailing partial batch of "
+                    f"{n % self.batch_size} samples cannot be sharded over "
+                    f"{runtime.size()} devices; drop the remainder or pad "
+                    "the dataset")
+        stop = (n - self.batch_size + 1) if self.drop_remainder else n
+        for start in range(0, max(stop, 0), self.batch_size):
+            idx = order[start:start + self.batch_size]
+            batch = tuple(a[idx] for a in self.arrays)
+            if sharding is not None:
+                batch = tuple(jax.device_put(b, sharding) for b in batch)
+            yield batch

@@ -46,6 +46,7 @@ from .discovery import (
 from .driver import ElasticDriver, ElasticRendezvous, Results
 from .notification import WorkerNotificationManager, notification_manager
 from .registration import WorkerStateRegistry
+from .sampler import ElasticSampler
 from .state import HostUpdateResult, JaxState, ObjectState, State, run_fn
 
 

@@ -1,0 +1,110 @@
+"""Elastic-aware dataset sampler.
+
+TPU-native rebuild of the reference's ``ElasticSampler``
+(``/root/reference/horovod/torch/elastic/sampler.py:1-122``): partitions a
+dataset's indices across ranks, tracks how many samples the epoch has
+consumed, and repartitions the *remaining* indices over the new world
+after an elastic reset — so a grown/shrunk job finishes the epoch without
+reprocessing or skipping samples.
+
+Usage with :class:`horovod_tpu.elastic.State`::
+
+    sampler = hvd.elastic.ElasticSampler(len(dataset))
+    state = hvd.elastic.ObjectState(sampler=sampler.state_dict(), ...)
+    for epoch ...:
+        for batch_idx in batches_of(sampler.local_indices(), batch):
+            ...
+            sampler.record_batch(per_rank_batch_size)
+            state.sampler = sampler.state_dict()
+            state.commit()
+        sampler.set_epoch(epoch + 1)
+
+After a reset, restore with ``sampler.load_state_dict(state.sampler)`` —
+``reset()`` re-reads the (new) world size/rank from the runtime.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+from .. import runtime
+
+
+class ElasticSampler:
+    """Deterministic cross-rank index partitioner with processed-sample
+    tracking (framework-free: yields plain integer indices)."""
+
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0):
+        self.dataset_size = int(dataset_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_num = 0
+        self.reset()
+
+    # -- epoch / progress --------------------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance to ``epoch`` and clear processed tracking. Call at the
+        END of each epoch so partially completed epochs are not
+        reprocessed (reference ``sampler.py:61-76``)."""
+        self.epoch = epoch
+        self.processed_num = 0
+        self.reset()
+
+    def record_batch(self, batch_size: int) -> None:
+        """Account one processed per-process batch (every data-feeding
+        process consumed ``batch_size`` samples this step)."""
+        self.processed_num += int(batch_size) * self.num_replicas
+
+    # -- elastic state -----------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return dict(epoch=self.epoch, processed_num=self.processed_num)
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = state["epoch"]
+        self.processed_num = state["processed_num"]
+        self.reset()
+
+    def reset(self) -> None:
+        """Repartition the unprocessed indices over the current world
+        (called automatically after load_state_dict/set_epoch; the elastic
+        reset path restores state then continues with the new size).
+
+        The partition unit is the data-feeding *process*, not the chip: in
+        the SPMD model one process materializes its whole local batch and
+        the mesh sharding spreads it over that process's chips (the
+        reference's 1-GPU-per-process sampler generalizes this way)."""
+        self.num_replicas = (runtime.process_count()
+                             if runtime.is_initialized() else 1)
+        self.rank = (runtime.process_rank()
+                     if runtime.is_initialized() else 0)
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(indices)
+        self.remaining_indices = indices[self.processed_num:]
+        self.num_samples = int(
+            math.ceil(len(self.remaining_indices) / max(self.num_replicas, 1)))
+        self.total_size = self.num_samples * self.num_replicas
+
+    # -- iteration ---------------------------------------------------------
+
+    def local_indices(self) -> list:
+        """This process's indices for the rest of the epoch (padded
+        cyclically so every process yields the same count — SPMD steps
+        stay aligned)."""
+        indices = list(self.remaining_indices)
+        if not indices:
+            return []
+        reps = -(-self.total_size // len(indices))  # ceil: full cyclic pad
+        indices = (indices * reps)[:self.total_size]
+        return indices[self.rank:self.total_size:self.num_replicas]
+
+    def __iter__(self):
+        return iter(self.local_indices())
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,7 +1,8 @@
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from .sync_batch_norm import SyncBatchNorm
 from .transformer import TransformerConfig, TransformerLM, param_shardings
 
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
-    "TransformerConfig", "TransformerLM", "param_shardings",
+    "SyncBatchNorm", "TransformerConfig", "TransformerLM", "param_shardings",
 ]

@@ -1,0 +1,3 @@
+from .runner import RayExecutor
+
+__all__ = ["RayExecutor"]

@@ -1,0 +1,126 @@
+"""Ray executor tests with an in-process stub of the Ray API (Ray itself
+is not installed here; the reference tests run against local Ray,
+``test/single/test_ray.py`` — the stub checks the same contract: actor
+creation, env seeding, per-rank fn execution, shutdown)."""
+
+import os
+import sys
+import types
+
+import pytest
+
+from horovod_tpu.ray import RayExecutor
+
+
+class _Future:
+    def __init__(self, value):
+        self.value = value
+
+
+class _ActorMethod:
+    def __init__(self, bound):
+        self._bound = bound
+
+    def remote(self, *args, **kwargs):
+        return _Future(self._bound(*args, **kwargs))
+
+
+class _ActorHandle:
+    def __init__(self, instance):
+        self._instance = instance
+
+    def __getattr__(self, name):
+        return _ActorMethod(getattr(self._instance, name))
+
+
+class _RemoteCls:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def options(self, **kwargs):
+        return self
+
+    def remote(self, *args, **kwargs):
+        return _ActorHandle(self._cls(*args, **kwargs))
+
+
+def _make_stub_ray():
+    ray = types.ModuleType("ray")
+    ray.util = types.SimpleNamespace(
+        get_node_ip_address=lambda: "127.0.0.1")
+    ray.is_initialized = lambda: True
+    ray.init = lambda *a, **k: None
+    ray.remote = lambda cls: _RemoteCls(cls)
+    ray.get = lambda futures: ([f.value for f in futures]
+                               if isinstance(futures, list) else futures.value)
+    ray.kill = lambda actor: None
+    return ray
+
+
+@pytest.fixture()
+def stub_ray(monkeypatch):
+    ray = _make_stub_ray()
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    return ray
+
+
+def test_ray_executor_runs_fn_per_worker(stub_ray):
+    ex = RayExecutor(num_workers=3)
+    ex.start()
+    try:
+        results = ex.run(lambda x: x * 2, args=(21,))
+        assert results == [42, 42, 42]
+        assert ex.execute_single(lambda: "rank0") == "rank0"
+    finally:
+        ex.shutdown()
+
+
+def test_ray_executor_seeds_launcher_env(stub_ray):
+    ex = RayExecutor(num_workers=2, env_vars={"MY_FLAG": "7"})
+    ex.start()
+    try:
+        # stub actors run in-process: set_env mutated our os.environ
+        envs = ex.run(lambda: {k: v for k, v in os.environ.items()
+                               if k.startswith("HVD_") or k == "MY_FLAG"})
+        # every worker saw the full launcher contract
+        for env in envs:
+            assert env["HVD_SIZE"] == "2"
+            assert env["HVD_NUM_PROCESSES"] == "2"
+            assert env["HVD_KV_ADDR"]
+            assert env["HVD_KV_PORT"]
+            assert env["HVD_COORDINATOR_ADDR"] == "127.0.0.1"
+            assert env["HVD_SECRET_KEY"]
+            assert env["MY_FLAG"] == "7"
+        # in-process actors share one os.environ, so the distinct per-rank
+        # values can't be observed here; check the seeded dicts instead
+        slots_env = [ex._rdv.worker_env(s) for s in ex._build_slots(
+            ["127.0.0.1", "127.0.0.1"])]
+        assert [e["HVD_RANK"] for e in slots_env] == ["0", "1"]
+        assert [e["HVD_LOCAL_RANK"] for e in slots_env] == ["0", "1"]
+    finally:
+        ex.shutdown()
+        for k in [k for k in os.environ if k.startswith("HVD_")]:
+            del os.environ[k]
+
+
+def test_ray_executor_multi_host_slots(stub_ray):
+    ex = RayExecutor(num_workers=4)
+    slots = ex._build_slots(["h1", "h1", "h2", "h2"])
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.local_rank for s in slots] == [0, 1, 0, 1]
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+    assert all(s.cross_size == 2 and s.local_size == 2 for s in slots)
+
+
+def test_ray_executor_requires_start(stub_ray):
+    ex = RayExecutor(num_workers=1)
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(lambda: 1)
+
+
+def test_module_imports_without_ray(monkeypatch):
+    monkeypatch.setitem(sys.modules, "ray", None)
+    # constructing the executor must not import ray; only start() does
+    ex = RayExecutor(num_workers=2)
+    with pytest.raises((ImportError, RuntimeError)):
+        ex.start()
