@@ -67,19 +67,23 @@ def main():
         mesh, axis = hvd.mesh(), hvd.axis_name()
         step = train_step_fn(mesh, axis)
         sharding = NamedSharding(mesh, P(axis))
-        n = hvd.size()
-        batch = args.batch_per_rank * n
+        nproc = hvd.process_count()
+        batch = args.batch_per_rank * nproc
         for state.epoch in range(state.epoch, args.epochs):
             idx_all = sampler.local_indices()
             for start in range(0, len(idx_all) - args.batch_per_rank + 1,
                                args.batch_per_rank):
-                # every rank takes its own slice; globally the batch covers
-                # `batch` distinct samples
+                # the sampler partitions per data-feeding process; the
+                # global batch is the concatenation of every process's
+                # slice. Each process only materializes its own region of
+                # the global array, so tiling its slice nproc times places
+                # the right rows at its offset — the batch covers `batch`
+                # DISTINCT samples globally, sharded over all chips.
                 local = idx_all[start:start + args.batch_per_rank]
                 gx = np.concatenate(
-                    [data_x[local]] * n) if n > 1 else data_x[local]
+                    [data_x[local]] * nproc) if nproc > 1 else data_x[local]
                 gy = np.concatenate(
-                    [data_y[local]] * n) if n > 1 else data_y[local]
+                    [data_y[local]] * nproc) if nproc > 1 else data_y[local]
                 x = jax.device_put(gx[:batch], sharding)
                 y = jax.device_put(gy[:batch], sharding)
                 state.params, state.opt_state, loss = step(

@@ -58,13 +58,11 @@ class SyncBatchNorm(nn.BatchNorm):
                 axis = None
         if use_running_average is None:
             use_running_average = self.use_running_average
-        bn = nn.BatchNorm(
-            use_running_average=use_running_average,
-            axis=self.axis, momentum=self.momentum, epsilon=self.epsilon,
-            dtype=self.dtype, param_dtype=self.param_dtype,
-            use_bias=self.use_bias, use_scale=self.use_scale,
-            bias_init=self.bias_init, scale_init=self.scale_init,
-            axis_index_groups=self.axis_index_groups,
-            use_fast_variance=self.use_fast_variance,
-            axis_name=axis, name="sync_bn")
-        return bn(x)
+        # forward every nn.BatchNorm field (robust to fields flax adds),
+        # overriding only the axis_name resolution above
+        fields = {f for f in nn.BatchNorm.__dataclass_fields__
+                  if f not in ("parent", "name")}
+        kwargs = {f: getattr(self, f) for f in fields}
+        kwargs.update(use_running_average=use_running_average,
+                      axis_name=axis)
+        return nn.BatchNorm(name="sync_bn", **kwargs)(x)

@@ -1,21 +1,32 @@
 """Adasum: scale-invariant gradient combination.
 
-Rebuild of the reference's Adasum (``/root/reference/horovod/common/ops/adasum/adasum.h:194-342``):
-vector-halving distance-doubling (VHDD) recursive reduction where each level
-pairs ranks ``r`` and ``r ^ 2^level`` and combines their vectors *a*, *b* as
+Rebuild of the reference's Adasum
+(``/root/reference/horovod/common/ops/adasum/adasum.h:194-342``):
+**vector-halving distance-doubling** (VHDD) — at level ``L`` ranks ``r``
+and ``r ^ L`` split their current segment in half, exchange the half they
+don't keep, and combine
 
     a' = (1 - a.b / (2 |a|^2)) a + (1 - a.b / (2 |b|^2)) b
 
-(the ``FusedPairwiseReduceWithComm`` math, ``adasum.h:248-342``), which keeps
-the magnitude of the combined update stable when gradients point the same
-way (scale invariance) and adds them when orthogonal.
+where the dot/norms are accumulated over the *distributed* logical vectors
+(partial sums reduced over the block of ranks sharing them — the
+reference's ``normAndDots`` allreduce over ``reduction_comms``,
+``adasum.h:310-330``). A reverse halving-doubling phase gathers the
+combined segments back. Each rank moves ``|v|/2 + |v|/4 + ... ≈ |v|`` per
+phase — ~2·|v| total, the reference's bandwidth shape — instead of the
+``|v|·log n`` of a naive full-vector XOR tree.
 
-TPU-native mapping: the XOR-partner exchange becomes ``lax.ppermute`` over
-the mesh axis; the pairwise combine is a fused elementwise+reduction XLA
-program. The combine is symmetric, so both partners compute identical
-results locally — after log2(n) levels every rank holds the full Adasum
-reduction (no separate allgather leg needed, unlike the MPI p2p version
-``adasum_mpi.cc``).
+TPU-native mapping: the point-to-point exchanges are ``lax.ppermute`` over
+the mesh axis with static per-level permutations; the segment sizes halve
+at trace time (unrolled python loop → static shapes); per-rank half
+selection is a ``dynamic_slice`` with a traced offset. Non-power-of-two
+worlds fold the extra ranks into the leading power-of-two block before the
+VHDD and broadcast back after (the reference's ``nearest_power_2``
+handling, ``adasum.h:215-224``); process-set subsets run the same schedule
+over the member rank list. The hierarchical variant (reference
+``AdasumGpuAllreduceOp``, ``adasum_gpu_operations.cc``: node-local
+reduce-scatter, Adasum across nodes, allgather back) maps to ICI
+``psum_scatter`` → DCN VHDD → ICI ``all_gather``.
 
 Accumulation note (SURVEY §7 hard part (d)): dot products and norms are
 accumulated in float32 even for bf16/fp16 inputs.
@@ -30,46 +41,171 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .. import runtime
 from ..process_sets import ProcessSet, _resolve
 
 
+def _coeffs(dot, na, nb):
+    """Scale-invariant combine coefficients (adasum.h:248-342), guarding
+    zero-norm inputs like the reference."""
+    acoeff = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)),
+                       1.0)
+    bcoeff = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)),
+                       1.0)
+    return acoeff, bcoeff
+
+
 def _pairwise_combine(a, b):
-    """Scale-invariant pairwise combine (adasum.h:248-342)."""
+    """Whole-vector pairwise combine (both vectors fully local)."""
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)
-    dot = jnp.sum(af * bf)
-    na = jnp.sum(af * af)
-    nb = jnp.sum(bf * bf)
-    acoeff = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)), 1.0)
-    bcoeff = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)), 1.0)
-    out = acoeff * af + bcoeff * bf
-    return out.astype(a.dtype)
+    acoeff, bcoeff = _coeffs(jnp.sum(af * bf), jnp.sum(af * af),
+                             jnp.sum(bf * bf))
+    return (acoeff * af + bcoeff * bf).astype(a.dtype)
 
 
-def adasum_reduce(x, axis, groups=None):
-    """Traced-mode Adasum allreduce over mesh axis ``axis`` via a
-    ppermute XOR-partner tree. Requires a power-of-two axis size."""
-    if groups is not None:
-        raise NotImplementedError(
-            "Adasum over a process-set subset is not supported yet; "
-            "use the eager path (sub-mesh) or the global set.")
-    n = lax.axis_size(axis) if hasattr(lax, "axis_size") else None
-    if n is None:
-        n = lax.psum(1, axis)
-    n = int(n)
-    if n & (n - 1):
-        raise NotImplementedError(
-            f"Adasum requires a power-of-two rank count (got {n}); the "
-            "reference builds power-of-two reduction comms the same way "
-            "(adasum_mpi.cc).")
+def _block_groups(members, block, world):
+    """psum groups: ``members`` split into blocks of ``block`` + singleton
+    non-members (a partition of the whole axis; unequal group sizes are
+    legal for psum)."""
+    member_set = set(members)
+    groups = [members[i:i + block] for i in range(0, len(members), block)]
+    groups.extend([r] for r in range(world) if r not in member_set)
+    return groups
+
+
+def _vhdd(x, axis, members, world, dot_extra_axis=None):
+    """Distributed VHDD Adasum of the flat vector ``x`` over the member
+    ranks. Every member returns the full combined vector; non-members
+    return their input unchanged. ``dot_extra_axis`` additionally reduces
+    the coefficient dot/norms over another mesh axis — the hierarchical
+    mode's scatter axis, where each logical vector is itself distributed
+    (the reference's reduction_comms span those ranks too,
+    ``adasum.h:310-330``)."""
+    n = len(members)
+    p = 1
+    while (p << 1) <= n:
+        p <<= 1
+    extras = n - p  # members[p:] fold into members[:extras]
+
+    idx = lax.axis_index(axis)
+    members_arr = jnp.array(members)
+    # my position within the member list (garbage for non-members — all
+    # their lanes are masked by singleton psum groups / missing perms)
+    pos = jnp.sum((members_arr < idx).astype(jnp.int32))
+
+    orig_dtype = x.dtype
+    seg = x.astype(jnp.float32)
+
+    # --- fold the non-power-of-two tail (adasum.h nearest_power_2) -------
+    if extras:
+        perm_in = [(members[p + i], members[i]) for i in range(extras)]
+        recv = lax.ppermute(seg, axis, perm_in)  # zeros where no sender
+        if dot_extra_axis is None:
+            folded = _pairwise_combine(seg, recv)
+        else:
+            stats = lax.psum(jnp.stack([jnp.sum(seg * recv),
+                                        jnp.sum(seg * seg),
+                                        jnp.sum(recv * recv)]),
+                             dot_extra_axis)
+            ac, bc = _coeffs(stats[0], stats[1], stats[2])
+            folded = ac * seg + bc * recv
+        is_target = pos < extras
+        seg = jnp.where(is_target, folded, seg)
+
+    active = members[:p]
+
+    # --- halving (up) phase ----------------------------------------------
+    m = seg.shape[0]
     level = 1
-    while level < n:
-        perm = [(r, r ^ level) for r in range(n)]
-        partner = lax.ppermute(x, axis, perm)
-        x = _pairwise_combine(x, partner)
+    while level < p:
+        half = m // 2
+        bit = (pos // level) % 2  # (pos & level) != 0, traced-friendly
+        my_keep = lax.dynamic_slice(seg, (bit * half,), (half,))
+        my_send = lax.dynamic_slice(seg, ((1 - bit) * half,), (half,))
+        perm = [(active[i], active[i ^ level]) for i in range(p)]
+        recv = lax.ppermute(my_send, axis, perm)
+        a_part = jnp.where(bit == 0, my_keep, recv)
+        b_part = jnp.where(bit == 0, recv, my_keep)
+        groups = _block_groups(active, 2 * level, world)
+        # one fused collective for dot/|a|^2/|b|^2 per level (the
+        # reference's single normAndDots allreduce, adasum.h:310-330)
+        stats = jnp.stack([jnp.sum(a_part * b_part),
+                           jnp.sum(a_part * a_part),
+                           jnp.sum(b_part * b_part)])
+        stats = lax.psum(stats, axis, axis_index_groups=groups)
+        if dot_extra_axis is not None:
+            stats = lax.psum(stats, dot_extra_axis)
+        acoeff, bcoeff = _coeffs(stats[0], stats[1], stats[2])
+        seg = acoeff * a_part + bcoeff * b_part
+        m = half
         level <<= 1
-    return x
+
+    # --- doubling (down) phase -------------------------------------------
+    level = p >> 1
+    while level >= 1:
+        bit = (pos // level) % 2
+        perm = [(active[i], active[i ^ level]) for i in range(p)]
+        recv = lax.ppermute(seg, axis, perm)
+        out = jnp.zeros((2 * m,), seg.dtype)
+        out = lax.dynamic_update_slice(out, seg, (bit * m,))
+        out = lax.dynamic_update_slice(out, recv, ((1 - bit) * m,))
+        seg = out
+        m *= 2
+        level >>= 1
+
+    # --- unfold: send the result back to the folded tail ------------------
+    if extras:
+        perm_out = [(members[i], members[p + i]) for i in range(extras)]
+        recv = lax.ppermute(seg, axis, perm_out)
+        is_extra_member = pos >= p
+        seg = jnp.where(is_extra_member, recv, seg)
+
+    is_member = jnp.isin(idx, members_arr)
+    return jnp.where(is_member, seg, x.astype(jnp.float32)).astype(orig_dtype)
+
+
+def adasum_reduce(x, axis, groups=None, *, dot_extra_axis=None):
+    """Traced-mode Adasum allreduce over mesh axis ``axis`` (any member
+    count; ``groups`` = a process-set partition restricts it to the
+    member group, non-members pass through unchanged)."""
+    world = int(lax.psum(1, axis))
+    if groups is None:
+        members = list(range(world))
+    else:
+        members = list(groups[0])
+    if len(members) == 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    # pad so every halving level splits evenly
+    p = 1
+    while (p << 1) <= len(members):
+        p <<= 1
+    pad = (-flat.shape[0]) % p
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    out = _vhdd(flat, axis, members, world, dot_extra_axis=dot_extra_axis)
+    return out[:x.size].reshape(shape)
+
+
+def adasum_hierarchical_traced(x, ici_axis, dcn_axis):
+    """Two-level Adasum (reference ``AdasumGpuAllreduceOp``): SUM
+    reduce-scatter over the fast ICI axis, scale-invariant Adasum across
+    the DCN axis on each piece, allgather back over ICI. Matches the
+    reference's semantics where the node-local reduction is a plain sum
+    and Adasum applies across nodes (``operations.cc:161-162``)."""
+    orig_dtype = x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    n_ici = lax.psum(1, ici_axis)
+    pad = (-flat.shape[0]) % n_ici
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    piece = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
+    # coefficient dots reduce over the scatter axis too: each logical
+    # vector is distributed across the ICI island
+    piece = adasum_reduce(piece, dcn_axis, dot_extra_axis=ici_axis)
+    out = lax.all_gather(piece, ici_axis, tiled=True)
+    return out[:x.size].reshape(x.shape).astype(orig_dtype)
 
 
 @functools.lru_cache(maxsize=None)
@@ -80,19 +216,36 @@ def _eager_adasum_fn(mesh: Mesh, axis: str):
         inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
 
 
+@functools.lru_cache(maxsize=None)
+def _eager_hier_adasum_fn(mesh: Mesh):
+    dcn_axis, ici_axis = mesh.axis_names
+
+    def inner(x):  # (1, ...) bundle shard over the 2-D mesh
+        return adasum_hierarchical_traced(x[0], ici_axis, dcn_axis)[None]
+
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)),
+        out_specs=P((dcn_axis, ici_axis)), check_vma=False))
+
+
 def adasum_allreduce(tensor, *, process_set: ProcessSet | None = None,
                      axis_name=None):
     """Adasum allreduce, eager or traced (reference op selection
-    ``operations.cc:161-162``; enqueue with ``ReduceOp.Adasum``)."""
+    ``operations.cc:161-162``; enqueue with ``ReduceOp.Adasum``). Routes
+    through the two-level ICI/DCN schedule when
+    ``HVD_HIERARCHICAL_ALLREDUCE`` applies (the reference pairs Adasum
+    with its hierarchical GPU op the same way)."""
+    from . import hierarchical
     from .collectives import PerRank, _as_bundle, _axis_is_bound, _resolve_axis
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
     if _axis_is_bound(axis):
         return adasum_reduce(tensor, axis, pset.axis_index_groups())
-    n = pset.size()
-    if n & (n - 1):
-        raise NotImplementedError(
-            f"Adasum requires a power-of-two rank count (got {n})")
     bundle, _ = _as_bundle(tensor, pset)
+    if hierarchical.hierarchical_enabled_for(pset):
+        fn = _eager_hier_adasum_fn(hierarchical.hierarchical_mesh())
+        return fn(bundle)[0]
+    # sub-mesh eager path: the pset mesh spans members only, so inside it
+    # the member list is simply 0..size-1
     out = _eager_adasum_fn(pset.mesh(), axis)(bundle)
     return out[0]

@@ -61,7 +61,14 @@ def _make_stub_ray():
 def stub_ray(monkeypatch):
     ray = _make_stub_ray()
     monkeypatch.setitem(sys.modules, "ray", ray)
-    return ray
+    # in-process stub actors mutate the shared os.environ via set_env;
+    # scrub the launcher contract afterwards so later tests don't inherit
+    # a stale rank/size or a dead rendezvous address
+    before = dict(os.environ)
+    yield ray
+    for k in [k for k in os.environ if k.startswith("HVD_")
+              and k not in before]:
+        del os.environ[k]
 
 
 def test_ray_executor_runs_fn_per_worker(stub_ray):
@@ -99,8 +106,6 @@ def test_ray_executor_seeds_launcher_env(stub_ray):
         assert [e["HVD_LOCAL_RANK"] for e in slots_env] == ["0", "1"]
     finally:
         ex.shutdown()
-        for k in [k for k in os.environ if k.startswith("HVD_")]:
-            del os.environ[k]
 
 
 def test_ray_executor_multi_host_slots(stub_ray):
