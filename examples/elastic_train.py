@@ -68,18 +68,21 @@ def main():
         step = train_step_fn(mesh, axis)
         sharding = NamedSharding(mesh, P(axis))
         nproc = hvd.process_count()
-        batch = args.batch_per_rank * nproc
+        # the sharded global batch must divide by the chip count: round
+        # the per-process batch up to a multiple of chips-per-process
+        local_chips = max(hvd.size() // nproc, 1)
+        per_rank = -(-args.batch_per_rank // local_chips) * local_chips
+        batch = per_rank * nproc
         for state.epoch in range(state.epoch, args.epochs):
             idx_all = sampler.local_indices()
-            for start in range(0, len(idx_all) - args.batch_per_rank + 1,
-                               args.batch_per_rank):
+            for start in range(0, len(idx_all) - per_rank + 1, per_rank):
                 # the sampler partitions per data-feeding process; the
                 # global batch is the concatenation of every process's
                 # slice. Each process only materializes its own region of
                 # the global array, so tiling its slice nproc times places
                 # the right rows at its offset — the batch covers `batch`
                 # DISTINCT samples globally, sharded over all chips.
-                local = idx_all[start:start + args.batch_per_rank]
+                local = idx_all[start:start + per_rank]
                 gx = np.concatenate(
                     [data_x[local]] * nproc) if nproc > 1 else data_x[local]
                 gy = np.concatenate(
@@ -88,7 +91,7 @@ def main():
                 y = jax.device_put(gy[:batch], sharding)
                 state.params, state.opt_state, loss = step(
                     state.params, state.opt_state, x, y)
-                sampler.record_batch(args.batch_per_rank)
+                sampler.record_batch(per_rank)
                 state.sampler = sampler.state_dict()
                 state.losses = state.losses + [
                     float(jax.block_until_ready(loss))]

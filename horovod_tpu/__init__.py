@@ -102,6 +102,7 @@ from . import callbacks
 from . import checkpoint
 from . import data
 from . import elastic
+from . import parallel
 from .callbacks import average_metrics, metric_average
 from .version import __version__
 
@@ -138,6 +139,6 @@ __all__ = [
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
-    "checkpoint", "data", "elastic", "average_metrics", "metric_average",
-    "SyncBatchNorm", "__version__",
+    "checkpoint", "data", "elastic", "parallel", "average_metrics",
+    "metric_average", "SyncBatchNorm", "__version__",
 ]

@@ -31,6 +31,13 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # long-context schedule: "full" (exact local attention), "ring"
+    # (horovod_tpu.parallel.ring_attention — sequence sharded over
+    # seq_axis, KV blocks rotate over ICI), or "ulysses" (all-to-all
+    # seq<->head switch). ring/ulysses require the model to run inside
+    # shard_map with seq_axis bound and the sequence dimension sharded.
+    attn_mode: str = "full"
+    seq_axis: str = "sp"
 
 
 class Attention(nn.Module):
@@ -47,13 +54,21 @@ class Attention(nn.Module):
         q = dense("q", (cfg.num_heads, head_dim))(x)
         k = dense("k", (cfg.num_heads, head_dim))(x)
         v = dense("v", (cfg.num_heads, head_dim))(x)
-        q = q / jnp.sqrt(head_dim).astype(cfg.dtype)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        seq = x.shape[1]
-        mask = jnp.tril(jnp.ones((seq, seq), bool))
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.attn_mode == "ring" and not self.is_initializing():
+            from ..parallel import ring_attention
+            out = ring_attention(q, k, v, cfg.seq_axis, causal=True)
+        elif cfg.attn_mode == "ulysses" and not self.is_initializing():
+            from ..parallel import ulysses_attention
+            out = ulysses_attention(q, k, v, cfg.seq_axis, causal=True)
+        else:
+            q = q / jnp.sqrt(head_dim).astype(cfg.dtype)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            seq = x.shape[1]
+            mask = jnp.tril(jnp.ones((seq, seq), bool))
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         # output proj: row-parallel
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), name="o",
                                dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -92,9 +107,14 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed")(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        if cfg.attn_mode in ("ring", "ulysses") and not self.is_initializing():
+            # sequence-parallel: this shard holds a block of the global
+            # sequence — positions are offset by the block index
+            positions = positions + jax.lax.axis_index(
+                cfg.seq_axis) * tokens.shape[1]
         pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
-                       param_dtype=jnp.float32, name="pos_embed")(
-            jnp.arange(tokens.shape[1]))
+                       param_dtype=jnp.float32, name="pos_embed")(positions)
         x = x + pos[None]
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"block_{i}")(x)

@@ -1,0 +1,26 @@
+"""Parallelism schedules beyond the reference's data-parallel scope.
+
+The reference implements data parallelism only (SURVEY.md §2.3); its
+``alltoall`` primitive (``operations.cc:1642``) and Adasum's neighbor
+exchanges are the building blocks long-context schedules need. This
+package makes the schedules themselves first-class for TPU:
+
+* :func:`ring_attention` — blockwise causal attention with KV blocks
+  rotating over the mesh axis (``lax.ppermute`` ring, online-softmax
+  accumulation): sequence length scales with the number of chips while
+  attention memory stays at one block per chip.
+* :func:`ulysses_attention` (+ the :func:`seq_to_heads`/:func:`heads_to_seq`
+  all-to-all switches) — DeepSpeed-Ulysses-style sequence parallelism:
+  resharding from sequence-parallel to head-parallel and back with two
+  ``lax.all_to_all``\\ s, running exact full-sequence attention locally.
+"""
+
+from .sequence import (
+    heads_to_seq,
+    ring_attention,
+    seq_to_heads,
+    ulysses_attention,
+)
+
+__all__ = ["ring_attention", "ulysses_attention", "seq_to_heads",
+           "heads_to_seq"]

@@ -1,0 +1,121 @@
+"""Sequence/context parallel attention schedules.
+
+Long-context training shards the *sequence* dimension over chips; the two
+standard schedules are both built from the framework's collective
+primitives (the reference exposes the primitives but no schedule,
+SURVEY.md §5.7):
+
+* **Ring attention** (Liu et al. 2023): keep Q resident, rotate K/V
+  blocks around a ``ppermute`` ring, accumulate with the online-softmax
+  (flash-attention) recurrence. Per-step the ring moves one KV block over
+  ICI while the MXU works on the previous one — communication overlaps
+  compute and peak memory is one block.
+* **Ulysses** (Jacobs et al. 2023): two ``all_to_all``\\ s reshard
+  (seq-sharded, heads-full) → (seq-full, heads-sharded), run exact local
+  attention over the full sequence, and reshard back. Cheaper collectives
+  for moderate sequence lengths; requires ``num_heads %% axis_size == 0``.
+
+Everything here runs inside ``jax.shard_map`` with the sequence axis
+bound; tensors use the (batch, seq, heads, head_dim) layout of
+:mod:`horovod_tpu.models.transformer`. Both paths are differentiable
+(``ppermute``/``all_to_all`` have transposes), so they drop into training
+steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax
+                 # rows finite (all-masked blocks produce 0 contributions)
+
+
+def _block_attend(q, k, v, qpos, kpos, causal, m, l, o):
+    """One blockwise online-softmax update (the flash-attention
+    recurrence). q: (b, sq, h, d); k/v: (b, sk, h, d); positions are
+    global token indices for masking. m/l/o are the running max,
+    normalizer, and weighted accumulator."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]  # (sq, sk)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis, *, causal: bool = True):
+    """Blockwise ring attention over mesh axis ``axis``.
+
+    Inside ``shard_map`` with the sequence dimension sharded over
+    ``axis``: ``q``/``k``/``v`` are this chip's (batch, seq_block, heads,
+    head_dim) blocks. K/V rotate around the ring; after ``axis_size``
+    steps every Q block has attended to the full sequence. Returns this
+    chip's output block (same shape as ``q``).
+    """
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    q = (q * scale).astype(q.dtype)
+
+    qpos = my * sq + jnp.arange(sq)
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    o = jnp.zeros((b, sq, h, d), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
+    for step in range(n):
+        kv_idx = (my - step) % n  # block held at this step
+        kpos = kv_idx * sk + jnp.arange(sk)
+        m, l, o = _block_attend(q, k, v, qpos, kpos, causal, m, l, o)
+        if step != n - 1:
+            k = lax.ppermute(k, axis, perm)
+            v = lax.ppermute(v, axis, perm)
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(v.dtype)
+
+
+def seq_to_heads(x, axis):
+    """All-to-all reshard (batch, seq/n, heads, d) → (batch, seq,
+    heads/n, d): trade sequence sharding for head sharding (the Ulysses
+    forward switch)."""
+    n = lax.psum(1, axis)
+    if x.shape[2] % n:
+        raise ValueError(
+            f"num_heads {x.shape[2]} must divide by the sequence-parallel "
+            f"axis size {n} for the Ulysses all-to-all")
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def heads_to_seq(x, axis):
+    """Inverse of :func:`seq_to_heads`: (batch, seq, heads/n, d) →
+    (batch, seq/n, heads, d)."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, axis, *, causal: bool = True):
+    """Ulysses sequence parallelism: reshard to head-parallel with one
+    all-to-all per tensor, run exact full-sequence attention on the local
+    head group, reshard the output back to sequence-parallel."""
+    q = seq_to_heads(q, axis)
+    k = seq_to_heads(k, axis)
+    v = seq_to_heads(v, axis)
+
+    s, d = q.shape[1], q.shape[3]
+    scale = 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k).astype(jnp.float32)
+    if causal:
+        pos = jnp.arange(s)
+        logits = jnp.where((pos[:, None] >= pos[None, :])[None, None],
+                           logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return heads_to_seq(out, axis)
