@@ -75,8 +75,9 @@ def chip_peak_flops(device) -> float | None:
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=128,
-                        help="per-chip batch size")
+    parser.add_argument("--batch-size", type=int, default=256,
+                        help="per-chip batch size (256 measures ~1.5x the "
+                             "throughput of 128 on v5e)")
     parser.add_argument("--num-iters", type=int, default=20)
     parser.add_argument("--num-warmup", type=int, default=3)
     parser.add_argument("--fp32", action="store_true",

@@ -68,10 +68,13 @@ def main():
         step = train_step_fn(mesh, axis)
         sharding = NamedSharding(mesh, P(axis))
         nproc = hvd.process_count()
-        # the sharded global batch must divide by the chip count: round
-        # the per-process batch up to a multiple of chips-per-process
-        local_chips = max(hvd.size() // nproc, 1)
-        per_rank = -(-args.batch_per_rank // local_chips) * local_chips
+        # the sharded global batch (per_rank * nproc) must divide by the
+        # chip count — round per_rank up to the smallest multiple that
+        # satisfies it (heterogeneous hosts included: the unit is
+        # size/gcd(size, nproc), not size//nproc)
+        import math
+        unit = hvd.size() // math.gcd(hvd.size(), nproc)
+        per_rank = -(-args.batch_per_rank // unit) * unit
         batch = per_rank * nproc
         for state.epoch in range(state.epoch, args.epochs):
             idx_all = sampler.local_indices()

@@ -1,0 +1,191 @@
+"""Pallas flash-attention block kernel for the sequence-parallel hot path.
+
+The ring/Ulysses schedules (:mod:`horovod_tpu.parallel.sequence`) spend
+their FLOPs in the blockwise online-softmax update. The jnp formulation
+materializes the (batch, heads, sq, sk) logits in HBM every ring step;
+this kernel keeps the whole update — QKᵀ, masking, the online-softmax
+rescale, and the PV accumulation — in VMEM, one pass per (batch × head)
+program, so HBM traffic per step drops from O(sq·sk) logits to the K/V
+blocks themselves (the flash-attention I/O shape, which is what the MXU
+needs to stay busy on long sequences).
+
+The kernel carries the running (m, l, acc) statistics **between**
+invocations, so the ring loop can rotate K/V with ``ppermute`` and call it
+once per step. Backward runs the jnp formulation under ``jax.vjp``
+(flash-style recompute: nothing but the carries is saved), wired up with
+``jax.custom_vjp`` so training steps differentiate straight through the
+kernel. CPU tests run the same kernel with ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attend_jnp(q, k, v, qpos0, kpos0, causal, m, l, acc):
+    """Reference jnp formulation of one block update (also the backward's
+    recompute target). Shapes: q (bh, sq, d); k/v (bh, sk, d); m/l
+    (bh, sq, 1); acc (bh, sq, d); qpos0/kpos0 int32 scalars (int — f32
+    cannot represent token offsets past 2^24)."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32)
+    if causal:
+        qpos = qpos0 + jnp.arange(q.shape[1], dtype=jnp.int32)
+        kpos = kpos0 + jnp.arange(k.shape[1], dtype=jnp.int32)
+        s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.einsum(
+        "bqk,bkd->bqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+DEFAULT_KV_TILE = 512
+
+
+def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
+                  acc_ref, mo_ref, lo_ref, acco_ref, m_s, l_s, acc_s, *,
+                  causal, kv_tile):
+    j = pl.program_id(1)
+    n_kv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():  # load this program's incoming carries into scratch
+        m_s[:] = m_ref[0]
+        l_s[:] = l_ref[0]
+        acc_s[:] = acc_ref[0]
+
+    q = q_ref[0]          # (sq, d)
+    k = k_ref[0]          # (kv_tile, d)
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (sq, kv_tile) on the MXU
+    if causal:
+        sq, sk = s.shape
+        # mosaic iota must be integer-typed; int32 offsets are exact
+        qpos = (qpos_ref[0]
+                + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0))
+        kpos = (kpos_ref[0] + j * kv_tile
+                + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    m_prev = m_s[:]       # (sq, 1) f32
+    l_prev = l_s[:]
+    acc_prev = acc_s[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_s[:] = m_new
+    l_s[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_s[:] = acc_prev * corr + pv
+
+    @pl.when(j == n_kv - 1)
+    def _flush():
+        mo_ref[0] = m_s[:]
+        lo_ref[0] = l_s[:]
+        acco_ref[0] = acc_s[:]
+
+
+def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    kv_tile = min(sk, DEFAULT_KV_TILE)
+    if sk % kv_tile:
+        kv_tile = sk  # ragged tail: fall back to one tile
+    n_kv = sk // kv_tile
+    kernel = functools.partial(_flash_kernel, causal=causal,
+                               kv_tile=kv_tile)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, n_kv),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i, j: (0,)),       # qpos0
+            pl.BlockSpec((1,), lambda i, j: (0,)),       # kpos0
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, kv_tile, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, kv_tile, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sq, 1), jnp.float32),
+            pltpu.VMEM((sq, 1), jnp.float32),
+            pltpu.VMEM((sq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray([qpos0], jnp.int32).reshape(1),
+      jnp.asarray([kpos0], jnp.int32).reshape(1),
+      q, k, v, m, l, acc)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def block_attend(q, k, v, qpos0, kpos0, causal, interpret, m, l, acc):
+    """One flash block update: returns the new (m, l, acc) carries.
+
+    Layout: q (bh, sq, d) pre-scaled; k/v (bh, sk, d); m/l (bh, sq, 1)
+    float32; acc (bh, sq, d) float32; qpos0/kpos0 int32 scalars (global
+    token offsets of the blocks for causal masking — integers, so offsets
+    past 2^24 stay exact).
+    """
+    qpos0 = jnp.asarray(qpos0, jnp.int32)
+    kpos0 = jnp.asarray(kpos0, jnp.int32)
+    return _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret)
+
+
+def _block_attend_fwd(q, k, v, qpos0, kpos0, causal, interpret, m, l, acc):
+    out = block_attend(q, k, v, qpos0, kpos0, causal, interpret, m, l, acc)
+    return out, (q, k, v, qpos0, kpos0, m, l, acc)
+
+
+def _block_attend_bwd(causal, interpret, res, cts):
+    import numpy as np
+
+    q, k, v, qpos0, kpos0, m, l, acc = res
+    # flash-style backward: recompute the block through the jnp
+    # formulation and differentiate that (nothing but the carries saved)
+    _, vjp = jax.vjp(
+        lambda q, k, v, m, l, acc: _attend_jnp(
+            q, k, v, qpos0, kpos0, causal, m, l, acc),
+        q, k, v, m, l, acc)
+    dq, dk, dv, dm, dl, dacc = vjp(tuple(cts))
+    zero_int = np.zeros((), jax.dtypes.float0)  # int operands: float0 ct
+    return dq, dk, dv, zero_int, zero_int, dm, dl, dacc
+
+
+block_attend.defvjp(_block_attend_fwd, _block_attend_bwd)
+
+
+def supported() -> bool:
+    """Whether the compiled kernel path is enabled: TPU backend and the
+    ``HVD_FLASH_ATTENTION`` knob set. Opt-in because on v5e XLA's own
+    fusion of the jnp formulation measures within ~10% of this kernel
+    (e.g. bf16 bh=16 sq=sk=2048 d=128: 4.6 ms pallas vs 4.2 ms XLA) —
+    the kernel's value is its bounded VMEM footprint (logits never
+    materialize in HBM), which matters for very long blocks, and explicit
+    control for future tuning."""
+    from ..utils import envs
+    return (jax.default_backend() == "tpu"
+            and envs.get_bool("FLASH_ATTENTION"))

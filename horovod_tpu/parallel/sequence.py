@@ -8,8 +8,13 @@ SURVEY.md §5.7):
 * **Ring attention** (Liu et al. 2023): keep Q resident, rotate K/V
   blocks around a ``ppermute`` ring, accumulate with the online-softmax
   (flash-attention) recurrence. Per-step the ring moves one KV block over
-  ICI while the MXU works on the previous one — communication overlaps
-  compute and peak memory is one block.
+  ICI while the MXU works on the previous one; attention *logits* never
+  materialize (O(block²) working set instead of O(seq²)). Note on
+  training memory: the current backward saves each step's rotated K/V
+  block as residuals, so K/V activation memory is O(sequence) per chip —
+  the same as vanilla attention's K/V (the quadratic logits saving still
+  holds); a re-rotating backward that keeps it at O(block) is future
+  work.
 * **Ulysses** (Jacobs et al. 2023): two ``all_to_all``\\ s reshard
   (seq-sharded, heads-full) → (seq-full, heads-sharded), run exact local
   attention over the full sequence, and reshard back. Cheaper collectives
@@ -32,25 +37,9 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax
                  # rows finite (all-masked blocks produce 0 contributions)
 
 
-def _block_attend(q, k, v, qpos, kpos, causal, m, l, o):
-    """One blockwise online-softmax update (the flash-attention
-    recurrence). q: (b, sq, h, d); k/v: (b, sk, h, d); positions are
-    global token indices for masking. m/l/o are the running max,
-    normalizer, and weighted accumulator."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    if causal:
-        mask = qpos[:, None] >= kpos[None, :]  # (sq, sk)
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-    p = jnp.exp(logits - m_new[..., None])
-    corr = jnp.exp(m - m_new)
-    l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
-    return m_new, l_new, o_new
-
-
-def ring_attention(q, k, v, axis, *, causal: bool = True):
+def ring_attention(q, k, v, axis, *, causal: bool = True,
+                   use_pallas: bool | None = None,
+                   interpret: bool = False):
     """Blockwise ring attention over mesh axis ``axis``.
 
     Inside ``shard_map`` with the sequence dimension sharded over
@@ -58,29 +47,46 @@ def ring_attention(q, k, v, axis, *, causal: bool = True):
     head_dim) blocks. K/V rotate around the ring; after ``axis_size``
     steps every Q block has attended to the full sequence. Returns this
     chip's output block (same shape as ``q``).
+
+    The per-step block update runs through the Pallas flash kernel
+    (:mod:`horovod_tpu.ops.flash`) on TPU — logits never touch HBM — and
+    through the jnp formulation elsewhere. ``use_pallas`` forces the
+    choice; ``interpret`` runs the kernel in interpreter mode (CPU tests).
     """
+    from ..ops import flash
+
+    if use_pallas is None:
+        use_pallas = flash.supported()
     n = int(lax.psum(1, axis))
     my = lax.axis_index(axis)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
-    q = (q * scale).astype(q.dtype)
 
-    qpos = my * sq + jnp.arange(sq)
-    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, sq), jnp.float32)
-    o = jnp.zeros((b, sq, h, d), jnp.float32)
+    # kernel layout: one (batch x head) program per row
+    qf = (q * scale).transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    m = jnp.full((b * h, sq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b * h, sq, 1), jnp.float32)
+    acc = jnp.zeros((b * h, sq, d), jnp.float32)
 
     perm = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
     for step in range(n):
         kv_idx = (my - step) % n  # block held at this step
-        kpos = kv_idx * sk + jnp.arange(sk)
-        m, l, o = _block_attend(q, k, v, qpos, kpos, causal, m, l, o)
+        qpos0 = (my * sq).astype(jnp.int32)
+        kpos0 = (kv_idx * sk).astype(jnp.int32)
+        if use_pallas or interpret:
+            m, l, acc = flash.block_attend(qf, kf, vf, qpos0, kpos0,
+                                           causal, interpret, m, l, acc)
+        else:
+            m, l, acc = flash._attend_jnp(qf, kf, vf, qpos0, kpos0,
+                                          causal, m, l, acc)
         if step != n - 1:
-            k = lax.ppermute(k, axis, perm)
-            v = lax.ppermute(v, axis, perm)
-    l = jnp.maximum(l, 1e-30)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(v.dtype)
+            kf = lax.ppermute(kf, axis, perm)
+            vf = lax.ppermute(vf, axis, perm)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3).astype(v.dtype)
 
 
 def seq_to_heads(x, axis):
@@ -101,21 +107,51 @@ def heads_to_seq(x, axis):
     return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
-def ulysses_attention(q, k, v, axis, *, causal: bool = True):
+def _local_flash(q, k, v, causal, use_pallas, interpret,
+                 kv_chunk: int = 1024):
+    """Exact local attention in flash form: (b, s, h, d) in/out, logits
+    never materialized at O(s²) — the Pallas kernel tiles KV internally;
+    the jnp fallback loops KV chunks with the same online-softmax
+    update."""
+    from ..ops import flash
+
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qf = (q * scale).transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    m = jnp.full((b * h, s, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b * h, s, 1), jnp.float32)
+    acc = jnp.zeros((b * h, s, d), jnp.float32)
+    zero = jnp.asarray(0, jnp.int32)
+    if use_pallas or interpret:
+        m, l, acc = flash.block_attend(qf, kf, vf, zero, zero, causal,
+                                       interpret, m, l, acc)
+    else:
+        chunk = min(kv_chunk, s)
+        if s % chunk:
+            chunk = s
+        for off in range(0, s, chunk):
+            m, l, acc = flash._attend_jnp(
+                qf, kf[:, off:off + chunk], vf[:, off:off + chunk],
+                zero, jnp.asarray(off, jnp.int32), causal, m, l, acc)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(v.dtype)
+
+
+def ulysses_attention(q, k, v, axis, *, causal: bool = True,
+                      use_pallas: bool | None = None,
+                      interpret: bool = False):
     """Ulysses sequence parallelism: reshard to head-parallel with one
     all-to-all per tensor, run exact full-sequence attention on the local
-    head group, reshard the output back to sequence-parallel."""
+    head group (in flash form — no O(seq²) logits in HBM), reshard the
+    output back to sequence-parallel."""
+    from ..ops import flash
+
+    if use_pallas is None:
+        use_pallas = flash.supported()
     q = seq_to_heads(q, axis)
     k = seq_to_heads(k, axis)
     v = seq_to_heads(v, axis)
-
-    s, d = q.shape[1], q.shape[3]
-    scale = 1.0 / (d ** 0.5)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k).astype(jnp.float32)
-    if causal:
-        pos = jnp.arange(s)
-        logits = jnp.where((pos[:, None] >= pos[None, :])[None, None],
-                           logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = _local_flash(q, k, v, causal, use_pallas, interpret)
     return heads_to_seq(out, axis)
