@@ -23,9 +23,14 @@ from .runtime import (
     AXIS_NAME,
     NotInitializedError,
     axis_name,
+    ccl_built,
     cross_rank,
     cross_size,
+    cuda_built,
+    ddl_built,
     devices,
+    gloo_built,
+    gloo_enabled,
     init,
     is_homogeneous,
     is_initialized,
@@ -33,11 +38,19 @@ from .runtime import (
     local_ranks,
     local_size,
     mesh,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     process_count,
     process_rank,
     rank,
+    rocm_built,
     shutdown,
     size,
+    tpu_built,
+    xla_built,
+    xla_enabled,
 )
 from .ops import (
     Adasum,
@@ -125,6 +138,9 @@ __all__ = [
     "cross_size", "devices", "init", "is_homogeneous", "is_initialized",
     "local_rank", "local_ranks", "local_size", "mesh", "process_count",
     "process_rank", "rank", "shutdown", "size",
+    "ccl_built", "cuda_built", "ddl_built", "gloo_built", "gloo_enabled",
+    "mpi_built", "mpi_enabled", "mpi_threads_supported", "nccl_built",
+    "rocm_built", "tpu_built", "xla_built", "xla_enabled",
     "Adasum", "Average", "Compression", "Handle", "Max", "Min", "PerRank",
     "Product", "ReduceOp", "Sum", "adasum_allreduce", "allgather",
     "allgather_async", "allgather_object", "allreduce", "allreduce_",

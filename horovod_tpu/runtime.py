@@ -17,6 +17,7 @@ a host process: one controller process drives ``local_size`` chips.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Any, Sequence
 
@@ -297,3 +298,85 @@ def process_of_rank(global_rank: int) -> int:
     """Index of the process owning chip ``global_rank`` (devices are
     rank-ordered process-major)."""
     return _get().devices[global_rank].process_index
+
+
+# ---------------------------------------------------------------------------
+# capability queries (reference basics.py:273-371) — migration shims so
+# `if hvd.nccl_built(): ...` style feature probes run unmodified. The
+# rebuild has exactly one collective backend: XLA over ICI/DCN.
+# ---------------------------------------------------------------------------
+
+def xla_built() -> bool:
+    """True: XLA collectives are the (only) backend of the rebuild."""
+    return True
+
+
+def xla_enabled() -> bool:
+    return True
+
+
+def tpu_built() -> bool:
+    """Whether a TPU backend is live (or configured) in this process.
+
+    Safe to call before :func:`init`, like the reference's ``*_built()``
+    probes: before the runtime is up this answers from configuration only
+    — touching ``jax.default_backend()`` here would initialize the XLA
+    client and break the later ``jax.distributed.initialize`` (see
+    ``_maybe_distributed_init``)."""
+    import jax
+
+    if is_initialized():
+        try:
+            return jax.default_backend() == "tpu"
+        except Exception:
+            return False
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or getattr(jax.config, "jax_platforms", None) or "")
+    return "tpu" in str(platforms).lower()
+
+
+def mpi_threads_supported() -> bool:
+    """Reference ``hvd.mpi_threads_supported()``. The rebuild has no MPI;
+    the analogous guarantee — collectives may be driven from multiple
+    Python threads — holds (the engine service thread does exactly that),
+    so answer True like a threads-enabled MPI build would."""
+    return True
+
+
+def mpi_enabled() -> bool:
+    """False: no MPI backend — XLA collectives replace it (SURVEY §5.8)."""
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    """False: the launcher's HTTP-KV rendezvous plays gloo's role."""
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    """False: ICI/DCN collectives are emitted by XLA, not NCCL."""
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False

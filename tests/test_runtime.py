@@ -45,3 +45,17 @@ def test_global_process_set(hvd):
     assert ps.ranks == list(range(8))
     assert ps.included(3)
     assert ps.rank(5) == 5
+
+
+def test_capability_queries():
+    """Reference basics.py:273-371 migration shims: feature probes run
+    unmodified; the single backend is XLA."""
+    import horovod_tpu as hvd
+    assert hvd.xla_built() and hvd.xla_enabled()
+    assert hvd.mpi_threads_supported()
+    assert not hvd.mpi_enabled() and not hvd.mpi_built()
+    assert not hvd.gloo_enabled() and not hvd.gloo_built()
+    assert not hvd.nccl_built() and not hvd.ddl_built()
+    assert not hvd.ccl_built() and not hvd.cuda_built()
+    assert not hvd.rocm_built()
+    assert hvd.tpu_built() in (True, False)  # backend-dependent
