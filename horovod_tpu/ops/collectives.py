@@ -151,9 +151,15 @@ def _reduce(x, axis, op: ReduceOp, groups):
         return lax.pmax(x, axis, axis_index_groups=groups)
     if op == ReduceOp.PRODUCT:
         if groups is not None:
-            raise NotImplementedError(
-                "PRODUCT over a process-set subset inside traced code is not "
-                "supported yet; use the eager path (sub-mesh).")
+            # gather over the member ring, reduce locally; non-members
+            # keep their own value (singleton-group semantics, matching
+            # SUM/MIN/MAX on unequal partitions)
+            members = list(groups[0])
+            g = _allgather_traced(x[None], axis, groups, members,
+                                  len(members))
+            prod = jnp.prod(g, axis=0)
+            member = jnp.isin(lax.axis_index(axis), jnp.array(members))
+            return jnp.where(member, prod, x)
         g = lax.all_gather(x, axis)
         return jnp.prod(g, axis=0)
     if op == ReduceOp.ADASUM:
@@ -192,9 +198,7 @@ def _allgather_traced(x, axis, groups, ranks, pset_size):
     # non-members move nothing, vs the O(world*k*|x|) zero-padded psum
     # this replaces (r2 VERDICT weak #4).
     k = pset_size
-    ranks_arr = jnp.array(ranks)
-    idx = lax.axis_index(axis)
-    pos = jnp.sum((ranks_arr < idx).astype(jnp.int32))  # my slot in the set
+    pos = _member_pos(axis, ranks)  # my slot in the set
     d0 = x.shape[0]
     orig_dtype = x.dtype
     if orig_dtype == jnp.bool_:
@@ -226,27 +230,77 @@ def _broadcast_traced(x, axis, root_rank, groups, ranks):
     return out
 
 
+def _member_pos(axis, ranks):
+    """This chip's position within the sorted member list (garbage for
+    non-members — their lanes are excluded from the member perms)."""
+    idx = lax.axis_index(axis)
+    return jnp.sum((jnp.array(ranks) < idx).astype(jnp.int32))
+
+
 def _alltoall_traced(x, axis, groups):
-    if groups is not None:
-        raise NotImplementedError(
-            "alltoall over a process-set subset inside traced code is not "
-            "supported yet; use the eager path (sub-mesh).")
-    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+    if groups is None:
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    # Subset alltoall as k-1 chunk rotations over the member ring
+    # (lax.all_to_all needs the whole axis). Chunk j of member i travels
+    # j-i hops forward; bandwidth (k-1)/k·|x| per member, the alltoall
+    # optimum. Non-member lanes produce garbage (never consumed).
+    ranks = list(groups[0])
+    k = len(ranks)
+    if x.shape[0] % k:
+        raise ValueError(
+            f"alltoall dim0 ({x.shape[0]}) must divide by the process-set "
+            f"size ({k})")
+    chunk = x.shape[0] // k
+    pos = _member_pos(axis, ranks)
+    out = jnp.zeros_like(x)
+    own = lax.dynamic_slice_in_dim(x, pos * chunk, chunk)
+    out = lax.dynamic_update_slice_in_dim(out, own, pos * chunk, 0)
+    for r in range(1, k):
+        # rotation r: my chunk for member (pos+r) travels r hops forward
+        perm = [(ranks[i], ranks[(i + r) % k]) for i in range(k)]
+        dest = (pos + r) % k
+        send = lax.dynamic_slice_in_dim(x, dest * chunk, chunk)
+        recv = lax.ppermute(send, axis, perm)
+        src = (pos - r) % k
+        out = lax.dynamic_update_slice_in_dim(out, recv, src * chunk, 0)
+    return out
 
 
 def _reducescatter_traced(x, axis, op, post, groups):
-    if groups is not None:
-        raise NotImplementedError(
-            "reducescatter over a process-set subset inside traced code is "
-            "not supported yet; use the eager path (sub-mesh).")
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise NotImplementedError("reducescatter supports SUM/AVERAGE")
-    out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if groups is None:
+        out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+        if op == ReduceOp.AVERAGE:
+            out = out / _axis_denominator(x, axis, groups).astype(out.dtype)
+        if post != 1.0:
+            out = out * post
+        return out
+    # Subset reduce-scatter as a k-1 step accumulate ring over the member
+    # list: member p ends holding chunk p fully reduced, each chunk
+    # visiting every member once ((k-1)/k·|x| per member — optimal).
+    ranks = list(groups[0])
+    k = len(ranks)
+    if x.shape[0] % k:
+        raise ValueError(
+            f"reducescatter dim0 ({x.shape[0]}) must divide by the "
+            f"process-set size ({k})")
+    chunk = x.shape[0] // k
+    pos = _member_pos(axis, ranks)
+    # accumulate in the native dtype like the global psum_scatter path
+    # (int sums stay exact; AVERAGE on ints is rejected upstream)
+    perm = [(ranks[i], ranks[(i + 1) % k]) for i in range(k)]
+    acc = lax.dynamic_slice_in_dim(x, ((pos - 1) % k) * chunk, chunk)
+    for t in range(k - 1):
+        recv = lax.ppermute(acc, axis, perm)
+        idx = (pos - t - 2) % k
+        acc = recv + lax.dynamic_slice_in_dim(x, idx * chunk, chunk)
     if op == ReduceOp.AVERAGE:
-        out = out / _axis_denominator(x, axis, groups).astype(out.dtype)
+        acc = acc / jnp.asarray(k, acc.dtype)
     if post != 1.0:
-        out = out * post
-    return out
+        acc = acc * post
+    return acc.astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -119,3 +119,127 @@ def test_dynamic_gate():
             table.add([0, 1])
     finally:
         table.dynamic_enabled = saved
+
+
+class TestSubsetTracedCollectives:
+    """Ring-based subset alltoall/reducescatter/product inside traced code
+    (previously NotImplementedError; the grouped lax primitives don't
+    support unequal partitions)."""
+
+    def _run(self, hvd, fn, data, out_spec=None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import numpy as np
+        mesh, axis = hvd.mesh(), hvd.axis_name()
+        sharded = jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(axis),
+            out_specs=out_spec if out_spec is not None else P(axis),
+            check_vma=False))
+        return np.asarray(sharded(jax.device_put(
+            data, NamedSharding(mesh, P(axis)))))
+
+    def test_subset_alltoall_traced(self, hvd):
+        import jax.numpy as jnp
+        import numpy as np
+        n = hvd.size()
+        if n < 4:
+            import pytest
+            pytest.skip("needs 4 devices")
+        ps = hvd.add_process_set([0, 1, 2])
+        try:
+            k, chunk = 3, 2
+            data = np.zeros((n, k * chunk, 2), np.float32)
+            for r in range(k):
+                for j in range(k):
+                    data[r, j * chunk:(j + 1) * chunk] = r * 10 + j
+
+            out = self._run(hvd, lambda x: hvd.alltoall(
+                x[0], process_set=ps)[None], data)
+            for r in range(k):  # member r receives chunk r of every member
+                for j in range(k):
+                    got = out[r, j * chunk:(j + 1) * chunk]
+                    assert np.allclose(got, j * 10 + r), (r, j, got)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_subset_reducescatter_traced(self, hvd):
+        import jax.numpy as jnp
+        import numpy as np
+        n = hvd.size()
+        if n < 4:
+            import pytest
+            pytest.skip("needs 4 devices")
+        ps = hvd.add_process_set([0, 2, 3])
+        try:
+            k, chunk = 3, 2
+            data = np.zeros((n, k * chunk), np.float32)
+            for i, r in enumerate([0, 2, 3]):
+                data[r] = np.arange(k * chunk) + 100 * i
+
+            out = self._run(hvd, lambda x: hvd.reducescatter(
+                x[0], op=hvd.Sum, process_set=ps)[None], data)
+            full_sum = data[[0, 2, 3]].sum(axis=0)
+            for i, r in enumerate([0, 2, 3]):
+                expect = full_sum[i * chunk:(i + 1) * chunk]
+                assert np.allclose(out[r], expect), (r, out[r], expect)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_subset_product_traced(self, hvd):
+        import numpy as np
+        n = hvd.size()
+        if n < 4:
+            import pytest
+            pytest.skip("needs 4 devices")
+        ps = hvd.add_process_set([1, 2])
+        try:
+            data = np.ones((n, 3), np.float32)
+            data[1] = [2, 3, 4]
+            data[2] = [5, 6, 7]
+            out = self._run(hvd, lambda x: hvd.allreduce(
+                x[0], op=hvd.Product, process_set=ps)[None], data)
+            assert np.allclose(out[1], [10, 18, 28])
+            assert np.allclose(out[2], [10, 18, 28])
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_subset_product_nonmember_keeps_value(self, hvd):
+        import numpy as np
+        n = hvd.size()
+        if n < 4:
+            import pytest
+            pytest.skip("needs 4 devices")
+        ps = hvd.add_process_set([1, 2])
+        try:
+            data = np.ones((n, 2), np.float32)
+            data[0] = [9, 9]   # non-member: must come back unchanged
+            data[1] = [2, 3]
+            data[2] = [4, 5]
+            out = self._run(hvd, lambda x: hvd.allreduce(
+                x[0], op=hvd.Product, process_set=ps)[None], data)
+            assert np.allclose(out[1], [8, 15])
+            assert np.allclose(out[0], [9, 9]), out[0]
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_subset_reducescatter_int_exact(self, hvd):
+        """Native-dtype accumulation: int32 sums above 2^24 stay exact
+        (code-review r3 regression — f32 accumulation rounded them)."""
+        import numpy as np
+        n = hvd.size()
+        if n < 4:
+            import pytest
+            pytest.skip("needs 4 devices")
+        ps = hvd.add_process_set([0, 1])
+        try:
+            big = 1 << 25
+            data = np.zeros((n, 4), np.int32)
+            data[0] = [big, 1, big, 1]
+            data[1] = [1, big, 1, big]
+            out = self._run(hvd, lambda x: hvd.reducescatter(
+                x[0], op=hvd.Sum, process_set=ps)[None], data)
+            assert out.dtype == np.int32
+            assert list(out[0]) == [big + 1, big + 1]
+            assert list(out[1]) == [big + 1, big + 1]
+        finally:
+            hvd.remove_process_set(ps)
