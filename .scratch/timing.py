@@ -1,0 +1,60 @@
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+log("imports done")
+hvd.init()
+n = hvd.size(); axis = hvd.axis_name(); mesh = hvd.mesh()
+log(f"hvd.init done n={n}")
+BS = 256
+model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, axis_name=axis)
+rng = jax.random.PRNGKey(0)
+images = jnp.asarray(np.random.default_rng(0).standard_normal((BS, 224, 224, 3), dtype=np.float32))
+labels = jnp.asarray(np.random.default_rng(1).integers(0, 1000, size=(BS,)))
+log("data on device")
+variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32), train=True)
+params, batch_stats = variables["params"], variables["batch_stats"]
+tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+opt_state = tx.init(params)
+log("init done")
+
+def train_step(params, batch_stats, opt_state, images, labels):
+    def loss_fn(p):
+        logits, mutated = model.apply({"params": p, "batch_stats": batch_stats}, images, train=True, mutable=["batch_stats"])
+        one_hot = jax.nn.one_hot(labels, 1000)
+        loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), -1))
+        return loss, mutated["batch_stats"]
+    (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, new_opt = tx.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    return new_params, new_stats, new_opt, loss
+
+step = jax.jit(jax.shard_map(train_step, mesh=mesh,
+    in_specs=(P(), P(), P(), P(axis), P(axis)), out_specs=(P(), P(), P(), P()),
+    check_vma=False), donate_argnums=(0, 1, 2))
+
+log("compiling...")
+params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state, images, labels)
+log("first step dispatched")
+lf = float(loss)
+log(f"first step complete loss={lf:.3f}")
+for i in range(2):
+    params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state, images, labels)
+    lf = float(loss)
+    log(f"warmup {i} complete loss={lf:.3f}")
+
+for N in (10, 20):
+    t0 = time.perf_counter()
+    for _ in range(N):
+        params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state, images, labels)
+    lf = float(loss)
+    dt = time.perf_counter() - t0
+    per = dt / N
+    log(f"N={N}: {per*1e3:.2f} ms/step  {BS/per:.0f} img/s  MFU {6.12e12/per/197e12:.2%}  loss={lf:.3f}")

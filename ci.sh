@@ -19,15 +19,24 @@ fail=0
 
 step() { echo; echo "=== $* ==="; }
 
-step "1/4 test suite (tests/, virtual 8-device mesh via conftest)"
+step "0/5 native build from source (no committed binaries)"
+python -c "from horovod_tpu._native import build_native; print(build_native(force=True))"
+
+step "1/5 test suite (tests/, virtual 8-device mesh via conftest)"
 python -m pytest tests/ -q -x
 
 if [[ "${1:-}" == "--fast" ]]; then
-  echo "--fast: skipping artifact + example checks"
+  step "fast: examples/mnist.py (hvdrun -np 2) then exit"
+  env -u XLA_FLAGS python -m horovod_tpu.runner.launch -np 2 -- \
+    python examples/mnist.py --smoke
+  echo "--fast: skipping second suite pass + artifact + full example checks"
   exit 0
 fi
 
-step "2/4 driver artifact: single-chip compile check (entry)"
+step "1b/5 test suite, second pass (flake detection)"
+python -m pytest tests/ -q -x
+
+step "2/5 driver artifact: single-chip compile check (entry)"
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -37,10 +46,10 @@ jax.jit(fn).lower(*args).compile()
 print("entry() compile OK")
 EOF
 
-step "3/4 driver artifact: multi-chip dryrun (8 virtual devices)"
+step "3/5 driver artifact: multi-chip dryrun (8 virtual devices)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
 
-step "4/4 example smoke runs (single-process 8-dev mesh + np=2 hvdrun, like gen-pipeline.sh:160-290)"
+step "4/5 example smoke runs (single-process 8-dev mesh + np=2 hvdrun, like gen-pipeline.sh:160-290)"
 for ex in examples/*.py; do
   echo "--- $ex (1 process, 8 virtual devices)"
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -105,6 +105,15 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_core_version.restype = ctypes.c_char_p
 
 
+def build_native(force: bool = False) -> str:
+    """Build the native library from ``native/`` sources, returning the
+    library path. ``force=True`` rebuilds unconditionally — used by the CI
+    gate so a stale or foreign-arch binary can never be what ships."""
+    if force or _needs_build():
+        _build()
+    return _LIB_PATH
+
+
 def load() -> ctypes.CDLL:
     """Load (building if needed) the native engine library."""
     global _lib

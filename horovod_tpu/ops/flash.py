@@ -28,18 +28,39 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def causal_mask_scores(s, qpos0, kpos0):
+    """Mask future positions of a (bh|, sq, sk) score block to the NEG_INF
+    sentinel. ``qpos0``/``kpos0`` are int32 global offsets of the blocks
+    (int — f32 cannot represent token offsets past 2^24)."""
+    sq, sk = s.shape[-2], s.shape[-1]
+    qpos = qpos0 + jnp.arange(sq, dtype=jnp.int32)
+    kpos = kpos0 + jnp.arange(sk, dtype=jnp.int32)
+    keep = qpos[:, None] >= kpos[None, :]
+    return jnp.where(jnp.expand_dims(keep, 0) if s.ndim == 3 else keep,
+                     s, NEG_INF)
+
+
+def zero_masked(p, s):
+    """Zero softmax weights at sentinel-masked score positions. When every
+    position seen so far is masked, the running max is still the NEG_INF
+    sentinel and ``s - m == 0`` there — exp(0)=1 would silently admit
+    garbage V rows. Zeroing explicitly makes any block visit order safe
+    (a fully-masked row just keeps l == 0). Must stay in lockstep with
+    the same guard inside the Pallas kernel (:func:`_flash_kernel`)."""
+    return jnp.where(s > NEG_INF / 2, p, 0.0)
+
+
 def _attend_jnp(q, k, v, qpos0, kpos0, causal, m, l, acc):
     """Reference jnp formulation of one block update (also the backward's
     recompute target). Shapes: q (bh, sq, d); k/v (bh, sk, d); m/l
-    (bh, sq, 1); acc (bh, sq, d); qpos0/kpos0 int32 scalars (int — f32
-    cannot represent token offsets past 2^24)."""
+    (bh, sq, 1); acc (bh, sq, d); qpos0/kpos0 int32 scalars."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32)
     if causal:
-        qpos = qpos0 + jnp.arange(q.shape[1], dtype=jnp.int32)
-        kpos = kpos0 + jnp.arange(k.shape[1], dtype=jnp.int32)
-        s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+        s = causal_mask_scores(s, qpos0, kpos0)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
+    if causal:
+        p = zero_masked(p, s)
     corr = jnp.exp(m - m_new)
     l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
     acc_new = acc * corr + jnp.einsum(
@@ -81,6 +102,10 @@ def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
     acc_prev = acc_s[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
+    if causal:
+        # fully-masked rows: m_new may still be the NEG_INF sentinel, making
+        # exp(s - m_new) == 1 at masked entries — zero them (see _attend_jnp)
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
     corr = jnp.exp(m_prev - m_new)
     pv = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),

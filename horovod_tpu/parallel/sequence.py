@@ -9,12 +9,15 @@ SURVEY.md §5.7):
   blocks around a ``ppermute`` ring, accumulate with the online-softmax
   (flash-attention) recurrence. Per-step the ring moves one KV block over
   ICI while the MXU works on the previous one; attention *logits* never
-  materialize (O(block²) working set instead of O(seq²)). Note on
-  training memory: the current backward saves each step's rotated K/V
-  block as residuals, so K/V activation memory is O(sequence) per chip —
-  the same as vanilla attention's K/V (the quadratic logits saving still
-  holds); a re-rotating backward that keeps it at O(block) is future
-  work.
+  materialize (O(block²) working set instead of O(seq²)). Training
+  memory is O(block) too: the backward is a **re-rotating recompute VJP**
+  (``_ring_core``'s custom_vjp) — the forward saves only this chip's home
+  Q/K/V blocks plus (out, lse); the backward restarts the ring from the
+  home blocks and rotates dK/dV accumulators around with them, so no
+  per-step K/V residuals ever accumulate. Causal runs also skip the
+  attention math for blocks that are entirely in the future of the local
+  Q block (a ``lax.cond``), recovering the ~2x FLOP overhead a naive
+  causal ring wastes on fully-masked blocks.
 * **Ulysses** (Jacobs et al. 2023): two ``all_to_all``\\ s reshard
   (seq-sharded, heads-full) → (seq-full, heads-sharded), run exact local
   attention over the full sequence, and reshard back. Cheaper collectives
@@ -29,12 +32,152 @@ steps.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax
                  # rows finite (all-masked blocks produce 0 contributions)
+
+
+def _ring_fwd_loop(qf, kf, vf, axis, causal, use_pallas, interpret):
+    """Run the forward ring, returning normalized output and log-sum-exp.
+
+    ``qf`` pre-scaled, (bh, sq, d); ``kf``/``vf`` (bh, sk, d) home blocks.
+    Causal steps whose KV block lies entirely in the future of the local Q
+    block skip the attention math through a ``lax.cond`` (the ppermute
+    still runs so the ring stays aligned).
+    """
+    from ..ops import flash
+
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    bh, sq, d = qf.shape
+    sk = kf.shape[1]
+    m = jnp.full((bh, sq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, sq, 1), jnp.float32)
+    acc = jnp.zeros((bh, sq, d), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
+    k_cur, v_cur = kf, vf
+    for step in range(n):
+        kv_idx = (my - step) % n  # block held at this step
+        qpos0 = (my * sq).astype(jnp.int32)
+        kpos0 = (kv_idx * sk).astype(jnp.int32)
+
+        def attend(carry, _k=k_cur, _v=v_cur, _qp=qpos0, _kp=kpos0):
+            m, l, acc = carry
+            if use_pallas or interpret:
+                return flash.block_attend(qf, _k, _v, _qp, _kp, causal,
+                                          interpret, m, l, acc)
+            return flash._attend_jnp(qf, _k, _v, _qp, _kp, causal,
+                                     m, l, acc)
+
+        if causal:
+            # block entirely in the future of every local query row:
+            # contributes nothing — skip its FLOPs at runtime
+            fully_future = kpos0 > qpos0 + (sq - 1)
+            m, l, acc = lax.cond(fully_future, lambda c: c, attend,
+                                 (m, l, acc))
+        else:
+            m, l, acc = attend((m, l, acc))
+        if step != n - 1:
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+    l_safe = jnp.maximum(l, 1e-30)
+    return acc / l_safe, m + jnp.log(l_safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_core(qf, kf, vf, axis, causal, use_pallas, interpret):
+    """Differentiable ring-attention core with O(block) training memory.
+
+    Returns ``(out, lse)`` where ``out`` is the normalized attention
+    output (float32) and ``lse`` the per-row log-sum-exp. The custom VJP
+    saves ONLY the home blocks + (out, lse) — never the rotated per-step
+    K/V blocks (which a plain ``jax.vjp`` through the loop would pin,
+    making per-chip K/V activation memory O(sequence),
+    the round-3 gap)."""
+    return _ring_fwd_loop(qf, kf, vf, axis, causal, use_pallas, interpret)
+
+
+def _ring_core_fwd(qf, kf, vf, axis, causal, use_pallas, interpret):
+    out, lse = _ring_fwd_loop(qf, kf, vf, axis, causal, use_pallas,
+                              interpret)
+    # O(block) residuals: home Q/K/V + out + lse. Nothing per-step.
+    return (out, lse), (qf, kf, vf, out, lse)
+
+
+def _ring_core_bwd(axis, causal, use_pallas, interpret, res, cts):
+    """Re-rotating backward: restart the ring from the home K/V blocks and
+    carry dK/dV accumulators around with them. Uses the flash backward
+    identities on the normalized softmax (p = exp(s - lse)):
+    dV += pᵀ·dO, dS = p ∘ (dO·Vᵀ − D), dQ += dS·K, dK += dSᵀ·Q with
+    D = rowsum(dO ∘ O). After n rotations each block's accumulator is back
+    on its home rank, so the returned cotangents line up with the inputs.
+    """
+    qf, kf, vf, out, lse = res
+    dout, _dlse = cts  # lse is a diagnostic output; its cotangent is zero
+    dout = dout.astype(jnp.float32)
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    bh, sq, d = qf.shape
+    sk = kf.shape[1]
+    D = jnp.sum(dout * out, axis=-1, keepdims=True)  # (bh, sq, 1)
+
+    dq = jnp.zeros((bh, sq, d), jnp.float32)
+    dk_acc = jnp.zeros((bh, sk, d), jnp.float32)
+    dv_acc = jnp.zeros((bh, sk, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = kf, vf
+    for step in range(n):
+        kv_idx = (my - step) % n
+        qpos0 = (my * sq).astype(jnp.int32)
+        kpos0 = (kv_idx * sk).astype(jnp.int32)
+
+        def block_grads(carry, _k=k_cur, _v=v_cur, _qp=qpos0, _kp=kpos0):
+            from ..ops import flash
+
+            dq, dk_a, dv_a = carry
+            s = jnp.einsum("bqd,bkd->bqk", qf, _k,
+                           preferred_element_type=jnp.float32)
+            if causal:
+                s = flash.causal_mask_scores(s, _qp, _kp)
+            p = jnp.exp(s - lse)  # normalized attention weights
+            if causal:
+                p = flash.zero_masked(p, s)
+            dv_blk = jnp.einsum("bqk,bqd->bkd", p, dout,
+                                preferred_element_type=jnp.float32)
+            dp = jnp.einsum("bqd,bkd->bqk", dout, _v.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - D)
+            dq_blk = jnp.einsum("bqk,bkd->bqd", ds, _k.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+            dk_blk = jnp.einsum("bqk,bqd->bkd", ds, qf.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+            return dq + dq_blk, dk_a + dk_blk, dv_a + dv_blk
+
+        if causal:
+            fully_future = kpos0 > qpos0 + (sq - 1)
+            dq, dk_acc, dv_acc = lax.cond(
+                fully_future, lambda c: c, block_grads, (dq, dk_acc, dv_acc))
+        else:
+            dq, dk_acc, dv_acc = block_grads((dq, dk_acc, dv_acc))
+
+        # dK/dV travel WITH their block; the extra nth rotation (vs the
+        # forward's n-1) returns every accumulator to its home rank.
+        dk_acc = lax.ppermute(dk_acc, axis, perm)
+        dv_acc = lax.ppermute(dv_acc, axis, perm)
+        if step != n - 1:
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+    return (dq.astype(qf.dtype), dk_acc.astype(kf.dtype),
+            dv_acc.astype(vf.dtype))
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def ring_attention(q, k, v, axis, *, causal: bool = True,
@@ -52,13 +195,14 @@ def ring_attention(q, k, v, axis, *, causal: bool = True,
     (:mod:`horovod_tpu.ops.flash`) on TPU — logits never touch HBM — and
     through the jnp formulation elsewhere. ``use_pallas`` forces the
     choice; ``interpret`` runs the kernel in interpreter mode (CPU tests).
+    Differentiating through this saves O(block) residuals (re-rotating
+    recompute backward, :func:`_ring_core_bwd`), so per-chip training
+    memory stays flat as the ring grows.
     """
     from ..ops import flash
 
     if use_pallas is None:
         use_pallas = flash.supported()
-    n = int(lax.psum(1, axis))
-    my = lax.axis_index(axis)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -67,25 +211,8 @@ def ring_attention(q, k, v, axis, *, causal: bool = True,
     qf = (q * scale).transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    m = jnp.full((b * h, sq, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b * h, sq, 1), jnp.float32)
-    acc = jnp.zeros((b * h, sq, d), jnp.float32)
-
-    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
-    for step in range(n):
-        kv_idx = (my - step) % n  # block held at this step
-        qpos0 = (my * sq).astype(jnp.int32)
-        kpos0 = (kv_idx * sk).astype(jnp.int32)
-        if use_pallas or interpret:
-            m, l, acc = flash.block_attend(qf, kf, vf, qpos0, kpos0,
-                                           causal, interpret, m, l, acc)
-        else:
-            m, l, acc = flash._attend_jnp(qf, kf, vf, qpos0, kpos0,
-                                          causal, m, l, acc)
-        if step != n - 1:
-            kf = lax.ppermute(kf, axis, perm)
-            vf = lax.ppermute(vf, axis, perm)
-    out = acc / jnp.maximum(l, 1e-30)
+    out, _lse = _ring_core(qf, kf, vf, axis, causal, bool(use_pallas),
+                           bool(interpret))
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3).astype(v.dtype)
 
 

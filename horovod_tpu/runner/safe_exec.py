@@ -25,9 +25,12 @@ def _kill_tree(pid: int, sig: int) -> None:
         import psutil
         try:
             root = psutil.Process(pid)
+            # children() re-reads /proc; the root can exit between the
+            # Process() lookup and here, raising NoSuchProcess from either
+            # call — treat both as "tree already gone".
+            procs = [root] + root.children(recursive=True)
         except psutil.NoSuchProcess:
             return
-        procs = [root] + root.children(recursive=True)
         for p in procs:
             try:
                 p.send_signal(sig)
