@@ -59,12 +59,20 @@ from ..utils import logging as hvd_logging
 class PerRank:
     """Bundle of per-rank values: ``array[i]`` is rank *i*'s tensor (ranks
     ordered by position in the process set). The eager-mode analog of "each
-    Horovod rank passes its local tensor"."""
+    Horovod rank passes its local tensor".
 
-    __slots__ = ("array",)
+    ``dim0s`` is set when the per-rank tensors have *different first
+    dimensions* (the reference's ragged allgather/alltoall contract,
+    ``collective_operations.h:143-178``): ``array`` is zero-padded to the
+    max dim0 and ``dim0s[i]`` is rank *i*'s valid row count. ``None``
+    means uniform."""
 
-    def __init__(self, array):
+    __slots__ = ("array", "dim0s")
+
+    def __init__(self, array, dim0s=None):
         self.array = array
+        self.dim0s = tuple(int(d) for d in dim0s) if dim0s is not None \
+            else None
 
     @property
     def shape(self):
@@ -81,27 +89,55 @@ class PerRank:
         return self.array[i]
 
     def to_list(self):
+        if self.dim0s is not None:
+            return [self.array[i, :self.dim0s[i]]
+                    for i in range(self.array.shape[0])]
         return [self.array[i] for i in range(self.array.shape[0])]
 
     def __repr__(self):
-        return f"PerRank(shape={tuple(self.array.shape)}, dtype={self.array.dtype})"
+        ragged = f", dim0s={self.dim0s}" if self.dim0s is not None else ""
+        return (f"PerRank(shape={tuple(self.array.shape)}, "
+                f"dtype={self.array.dtype}{ragged})")
 
 
 def per_rank(values, process_set: ProcessSet | None = None) -> PerRank:
     """Build a :class:`PerRank` bundle from a sequence of per-rank arrays
     (or an array whose leading axis already indexes ranks), sharded one
-    slice per chip of the process set."""
+    slice per chip of the process set. Per-rank arrays whose *first*
+    dimensions differ (trailing dims must match) produce a ragged bundle:
+    zero-padded to the max first dim with ``dim0s`` recording the valid
+    row counts — the input shape for ragged :func:`allgather` /
+    :func:`alltoall`."""
     pset = _resolve(process_set)
+    n = pset.size()
+    dim0s = None
     if isinstance(values, (list, tuple)):
-        arr = jnp.stack([jnp.asarray(v) for v in values])
+        arrs = [jnp.asarray(v) for v in values]
+        if len(arrs) != n:
+            raise ValueError(
+                f"per_rank got {len(arrs)} arrays for process set size {n}")
+        rests = {a.shape[1:] for a in arrs}
+        ndims = {a.ndim for a in arrs}
+        if len(ndims) > 1 or len(rests) > 1:
+            raise ValueError(
+                "per_rank arrays must agree on every dimension except the "
+                f"first, got shapes {[tuple(a.shape) for a in arrs]}")
+        d0s = [a.shape[0] if a.ndim else 1 for a in arrs]
+        if arrs[0].ndim and len(set(d0s)) > 1:
+            maxd = max(d0s)
+            arrs = [jnp.concatenate(
+                        [a, jnp.zeros((maxd - a.shape[0],) + a.shape[1:],
+                                      a.dtype)]) if a.shape[0] < maxd else a
+                    for a in arrs]
+            dim0s = d0s
+        arr = jnp.stack(arrs)
     else:
         arr = jnp.asarray(values)
-    n = pset.size()
     if arr.shape[0] != n:
         raise ValueError(
             f"per_rank leading axis {arr.shape[0]} != process set size {n}")
     sharding = NamedSharding(pset.mesh(), P(runtime.axis_name()))
-    return PerRank(jax.device_put(arr, sharding))
+    return PerRank(jax.device_put(arr, sharding), dim0s)
 
 
 # ---------------------------------------------------------------------------
@@ -438,12 +474,20 @@ def _eager_reducescatter_fn(mesh: Mesh, axis: str, op: ReduceOp, post: float):
         inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
 
 
-def _as_bundle(tensor, pset: ProcessSet):
+def _as_bundle(tensor, pset: ProcessSet, allow_ragged: bool = False):
     """Canonicalize eager input to a (pset.size, ...) bundle array.
 
-    Returns (bundle, was_bundled)."""
+    Returns (bundle, was_bundled). Ragged bundles (``PerRank.dim0s`` set)
+    are rejected unless the op supports per-rank first dims — otherwise
+    the zero padding would silently enter the reduction/exchange."""
     n = pset.size()
     if isinstance(tensor, PerRank):
+        if tensor.dim0s is not None and not allow_ragged:
+            raise ValueError(
+                "this collective requires uniform per-rank shapes; got a "
+                f"ragged per_rank bundle with first dims {tensor.dim0s} "
+                "(ragged first dims are supported by allgather and uneven "
+                "alltoall only, matching the reference's contract)")
         arr = tensor.array
         if arr.shape[0] != n:
             raise ValueError(
@@ -451,6 +495,27 @@ def _as_bundle(tensor, pset: ProcessSet):
         return arr, True
     arr = jnp.asarray(tensor)
     return jnp.broadcast_to(arr[None], (n,) + arr.shape), False
+
+
+def _member_process_view(pset: ProcessSet):
+    """(member_procs, one_to_one, my_pos): the process-level view of a
+    process set's chip ranks. ``one_to_one`` when the set's chips map 1:1
+    onto its member processes (engine world == set positions — devices are
+    rank-ordered process-major); ``my_pos`` is this process's position
+    among the members, -1 when not 1:1 or not a member."""
+    member_procs = sorted({runtime.process_of_rank(r) for r in pset.ranks})
+    one_to_one = (len(member_procs) == len(pset.ranks)
+                  and runtime.process_rank() in member_procs)
+    my_pos = member_procs.index(runtime.process_rank()) if one_to_one else -1
+    return member_procs, one_to_one, my_pos
+
+
+def _i64_digest(values) -> int:
+    """Stable non-zero crc32 digest of an int sequence (cross-process
+    validation of size metadata every member must agree on)."""
+    import zlib
+    return zlib.crc32(np.ascontiguousarray(
+        np.asarray(values, np.int64)).tobytes()) & 0x7FFFFFFF or 1
 
 
 def _gspmd_passthrough_check(op: ReduceOp, name: str) -> None:
@@ -709,9 +774,13 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
     ``hvd.allgather``; ``EnqueueTensorAllgather`` at ``operations.cc:1529``,
     displacement math at ``collective_operations.h:143-178``).
 
-    Traced mode requires uniform shapes across ranks (SPMD static shapes);
-    the reference's ragged first dimension is supported via
-    :func:`allgather_object` or explicit padding.
+    Ragged first dimensions are supported in eager mode (the reference's
+    allgatherv contract): pass a ragged :func:`per_rank` bundle
+    (single-controller), or — in multi-process jobs — each process simply
+    passes its local tensor and the per-rank row counts are exchanged
+    through the dynamic engine (the displacement negotiation of
+    ``collective_operations.h:143-178``). Joined processes contribute zero
+    rows. Traced mode requires uniform shapes (SPMD static shapes).
     """
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
@@ -723,11 +792,62 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
             "allgather() was called inside jit/pjit without a bound mesh axis. "
             "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
             "so the op can lower to an XLA collective.")
-    bundle, _ = _as_bundle(tensor, pset)
-    _negotiate_eager("allgather", REQ_ALLGATHER, name, bundle.shape[1:],
-                     bundle.dtype, pset)
+    local_d0s = tensor.dim0s if isinstance(tensor, PerRank) else None
+    bundle, _ = _as_bundle(tensor, pset, allow_ragged=True)
+
+    # Negotiation shape: this process's own first dim (rank-local in the
+    # engine, collective_operations.h:143-178); a digest of the full dim0s
+    # vector cross-validates ragged per_rank bundles like the uneven
+    # alltoall's splits matrix.
+    member_procs, one_to_one, my_pos = _member_process_view(pset)
+    crc = 0
+    neg_shape = bundle.shape[1:]
+    if local_d0s is not None:
+        crc = _i64_digest(local_d0s)
+        if one_to_one:
+            neg_shape = (local_d0s[my_pos],) + bundle.shape[2:]
+    resp = _negotiate_eager("allgather", REQ_ALLGATHER, name, neg_shape,
+                            bundle.dtype, pset, splits_crc=crc)
+
+    # Resolve the per-rank row counts. The routing rule must be a pure
+    # function of the engine response so active and joined processes build
+    # the SAME program (_execute_joined_zeros applies the identical rule):
+    # all engine dims equal -> uniform program; otherwise ragged with the
+    # padded dim = max over the ENGINE's rank view (not local padding).
+    d0s = list(local_d0s) if local_d0s is not None else None
+    maxd = max(d0s) if d0s else None
+    if resp is not None and resp.recv_splits:
+        pos = {p: i for i, p in enumerate(member_procs)}
+        eng = [int(resp.recv_splits[pos[runtime.process_of_rank(r)]])
+               for r in pset.ranks]
+        if d0s is None:
+            if len(set(eng)) > 1:
+                d0s = eng  # peers contributed different first dims
+                maxd = max(eng)
+        else:
+            if one_to_one:
+                for i, (e, loc) in enumerate(zip(eng, d0s)):
+                    if e not in (0, loc):
+                        raise ValueError(
+                            f"allgather dim0s disagree: engine negotiated "
+                            f"{e} rows for rank {pset.ranks[i]} but the "
+                            f"local per_rank bundle carries {loc}; processes "
+                            "passed different ragged bundles")
+            # engine view decides participation (0 = joined) AND the
+            # program's padded dim — every process, including joined ones
+            # reconstructing from recv_splits alone, derives the same value
+            d0s = [0 if e == 0 else loc for e, loc in zip(eng, d0s)]
+            maxd = max(eng)
+
     _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "allgather", "ALLGATHER"):
+        if d0s is not None:
+            return _execute_ragged_allgather(bundle, d0s, maxd, pset, axis)
+        if bundle.ndim >= 2 and bundle.shape[1] == 0:
+            # uniform zero-row gather: no data moves and XLA forbids a
+            # zero-size gather dim — the result is empty on every rank
+            # (joined peers skip the program identically)
+            return jnp.zeros((0,) + bundle.shape[2:], bundle.dtype)
         if hierarchical.hierarchical_allgather_enabled_for(pset):
             # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
             hmesh = hierarchical.hierarchical_mesh()
@@ -740,6 +860,33 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
             bundle = bundle[:, None]
             return _eager_allgather_fn(pset.mesh(), axis)(bundle).reshape(-1)
         return _eager_allgather_fn(pset.mesh(), axis)(bundle)
+
+
+def _execute_ragged_allgather(bundle, d0s, maxd, pset: ProcessSet, axis):
+    """Ragged eager allgather: pad every rank's block to the negotiated max
+    first dim, exchange with the uniform all-gather program (identical SPMD
+    computation on every process — ``maxd`` is derived from the engine's
+    shared view, so joined processes rebuild the same shape), then slice
+    the valid rows back out and concatenate (the pad/exchange/slice scheme
+    of the uneven alltoall applied to MPI_Allgatherv,
+    ``collective_operations.h:143-178``)."""
+    n = pset.size()
+    rest = bundle.shape[2:]
+    maxd = max(int(maxd), 1)
+    if bundle.shape[1] < maxd:
+        # local-tensor multi-process path: this process's rows are fewer
+        # than the global max — pad with zeros (never read back)
+        pad = jnp.zeros((n, maxd - bundle.shape[1]) + rest, bundle.dtype)
+        bundle = jnp.concatenate([bundle, pad], axis=1)
+    elif bundle.shape[1] > maxd:
+        # joined peers shrank the global max below the local padding
+        bundle = bundle[:, :maxd]
+    gathered = _eager_allgather_fn(pset.mesh(), axis)(bundle)  # (n*maxd,...)
+    parts = [gathered[r * maxd:r * maxd + d0s[r]] for r in range(n)
+             if d0s[r] > 0]
+    if not parts:
+        return jnp.zeros((0,) + rest, bundle.dtype)
+    return jnp.concatenate(parts, axis=0)
 
 
 def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
@@ -861,7 +1008,8 @@ def _alltoall_uneven(tensor, splits, pset: ProcessSet, axis,
             "through jit (the reference's uneven path is likewise "
             "runtime-dispatched, operations.cc:1642-1727)")
     n = pset.size()
-    bundle, _ = _as_bundle(tensor, pset)
+    local_d0s = tensor.dim0s if isinstance(tensor, PerRank) else None
+    bundle, _ = _as_bundle(tensor, pset, allow_ragged=True)
     d0 = bundle.shape[1]
     smat = np.asarray(splits, dtype=np.int64)
     if smat.ndim == 1:
@@ -872,7 +1020,17 @@ def _alltoall_uneven(tensor, splits, pset: ProcessSet, axis,
             f"got shape {tuple(smat.shape)}")
     if (smat < 0).any():
         raise ValueError("splits entries must be non-negative")
-    if (smat.sum(axis=1) > d0).any():
+    if local_d0s is not None:
+        # ragged per_rank bundle: each rank's row sum is bounded by that
+        # rank's OWN first dimension, not the padded bundle's
+        row_sums = smat.sum(axis=1)
+        for i in range(n):
+            if row_sums[i] > local_d0s[i]:
+                raise ValueError(
+                    f"sum of splits row {i} ({int(row_sums[i])}) exceeds "
+                    f"rank {i}'s first dimension ({local_d0s[i]}) "
+                    "(reference operations.cc:1703-1707)")
+    elif (smat.sum(axis=1) > d0).any():
         raise ValueError(
             f"sum of splits entries exceeds the first dimension ({d0}) "
             "(reference operations.cc:1703-1707)")
@@ -884,13 +1042,8 @@ def _alltoall_uneven(tensor, splits, pset: ProcessSet, axis,
     # when the set's chips map 1:1 onto its member processes (then the
     # engine's world == the matrix dimension; set positions and engine
     # ranks coincide because devices are rank-ordered process-major).
-    import zlib
-    crc = zlib.crc32(np.ascontiguousarray(smat, np.int64).tobytes()) \
-        & 0x7FFFFFFF or 1
-    member_procs = sorted({runtime.process_of_rank(r) for r in pset.ranks})
-    one_to_one = (len(member_procs) == len(pset.ranks)
-                  and runtime.process_rank() in member_procs)
-    my_pos = member_procs.index(runtime.process_rank()) if one_to_one else -1
+    crc = _i64_digest(smat)
+    member_procs, one_to_one, my_pos = _member_process_view(pset)
     my_row = smat[my_pos] if one_to_one else ()
     resp = _negotiate_eager("alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
                             bundle.dtype, pset,
@@ -983,17 +1136,33 @@ def _execute_joined_zeros(responses) -> None:
     pset = _resolve(None)
     axis = _resolve_axis(None)
     n = pset.size()
-    items = []  # ("barrier",) | (dtype, shape, gid, op, pre, post)
+    # ("barrier",) | ("allgather", dtype, rest, d0s) |
+    # (dtype, shape, gid, op, pre, post)
+    items = []
     for resp in responses:
         if resp.type == REQ_BARRIER:
             items.append(("barrier",))
+            continue
+        if resp.type == REQ_ALLGATHER:
+            dtype_name = _DTYPE_NAMES.get(resp.dtype)
+            if dtype_name is None:
+                raise RuntimeError(
+                    f"hvd.join(): cannot reconstruct dtype id {resp.dtype} "
+                    f"for zero contribution to {resp.tensor_names}")
+            # This process is joined: its row count is 0 (the engine never
+            # saw a request from it); peers' counts come on recv_splits.
+            # The first enqueuer's full shape distinguishes scalar gathers
+            # from zero-row tensor gathers and carries the trailing dims.
+            first_shape = tuple(resp.shapes[0]) if resp.shapes else ()
+            items.append(("allgather", jnp.dtype(dtype_name), first_shape,
+                          tuple(int(s) for s in resp.recv_splits)))
             continue
         if resp.type != REQ_ALLREDUCE:
             raise RuntimeError(
                 f"hvd.join(): another process scheduled a "
                 f"{resp.type_name} ({resp.tensor_names}) while this one is "
-                "joined; zero contribution is defined for allreduce/barrier "
-                "only (reference JoinOp semantics)")
+                "joined; zero contribution is defined for allreduce/"
+                "allgather/barrier only (reference JoinOp semantics)")
         dtype_name = _DTYPE_NAMES.get(resp.dtype)
         if dtype_name is None:
             raise RuntimeError(
@@ -1012,6 +1181,43 @@ def _execute_joined_zeros(responses) -> None:
             fn = _eager_allreduce_fn(pset.mesh(), axis, ReduceOp.SUM,
                                      1.0, 1.0)
             jax.block_until_ready(fn(jnp.zeros((n, 1), jnp.int32)))
+            i += 1
+            continue
+        if items[i][0] == "allgather":
+            _, dt, first_shape, proc_d0s = items[i]
+            rest = first_shape[1:] if first_shape else ()
+            # Expand per-process counts to per-rank rows and apply the
+            # SAME routing rule as the active path (allgather() above):
+            # all engine dims equal -> the uniform program; otherwise the
+            # ragged program padded to max over the engine view.
+            member_procs, _, _ = _member_process_view(pset)
+            pos = {p: j for j, p in enumerate(member_procs)}
+            d0s = [int(proc_d0s[pos[runtime.process_of_rank(r)]])
+                   for r in pset.ranks]
+            _autotune.record(int(np.prod(rest) or 1) * dt.itemsize
+                             * max(max(d0s), 1))
+            if len(set(d0s)) == 1:
+                # uniform (possibly zero-row) — mirror the active path's
+                # uniform branch exactly, hierarchical knob included
+                if len(first_shape) > 0 and d0s[0] == 0:
+                    # zero-row uniform gather: active peers run NO program
+                    i += 1
+                    continue
+                if len(first_shape) == 0:  # scalars: (n, 1) program
+                    zb = jnp.zeros((n, 1), dt)
+                else:
+                    zb = jnp.zeros((n, d0s[0]) + tuple(rest), dt)
+                if hierarchical.hierarchical_allgather_enabled_for(pset):
+                    out = hierarchical._eager_hier_allgather_fn(
+                        hierarchical.hierarchical_mesh())(zb)
+                else:
+                    out = _eager_allgather_fn(pset.mesh(), axis)(zb)
+            else:
+                maxd = max(d0s)
+                out = _execute_ragged_allgather(
+                    jnp.zeros((n, max(maxd, 1)) + tuple(rest), dt), d0s,
+                    maxd, pset, axis)
+            jax.block_until_ready(out)
             i += 1
             continue
         dt, shape, gid, op, pre, post = items[i]

@@ -261,7 +261,8 @@ class Engine {
         bool ok = q.shape.size() == orig.shape.size();
         for (size_t i = 1; ok && i < q.shape.size(); ++i)
           ok = q.shape[i] == orig.shape[i];
-        if (q.type == RequestType::ALLTOALL) {
+        if (q.type == RequestType::ALLTOALL ||
+            q.type == RequestType::ALLGATHER) {
           auto dit = entry.dim0_by_rank.find(rank_);
           int64_t d0 = q.shape.empty() ? 0 : q.shape[0];
           ok = ok && (dit == entry.dim0_by_rank.end() || dit->second == d0);
@@ -348,14 +349,16 @@ class Engine {
         e.first_seen = now;
         e.sequence = next_sequence_++;
         if (!q.splits.empty()) e.splits_by_rank[rank] = q.splits;
-        if (q.type == RequestType::ALLTOALL)
+        if (q.type == RequestType::ALLTOALL ||
+            q.type == RequestType::ALLGATHER)
           e.dim0_by_rank[rank] = q.shape.empty() ? 0 : q.shape[0];
         table_.emplace(q.name, std::move(e));
       } else {
         TableEntry& e = it->second;
         validate(e, q, rank);
         if (!q.splits.empty()) e.splits_by_rank[rank] = q.splits;
-        if (q.type == RequestType::ALLTOALL)
+        if (q.type == RequestType::ALLTOALL ||
+            q.type == RequestType::ALLGATHER)
           e.dim0_by_rank[rank] = q.shape.empty() ? 0 : q.shape[0];
         e.ready_ranks.insert(rank);
       }
@@ -373,6 +376,10 @@ class Engine {
         continue;  // never cached (controller.cc:100-104)
       if (!q.splits.empty())
         continue;  // uneven alltoall: recv_splits vary per call, never HIT
+      if (q.type == RequestType::ALLGATHER)
+        continue;  /* per-rank first dims are per-call runtime data a rank
+                    * cannot vouch for alone (another rank's dim may have
+                    * changed while this rank's bit says HIT) */
       if (cache_.cached(q) == ResponseCache::State::HIT) {
         int32_t bit = cache_.bit_of(q.name);
         if (bit >= 0) bits_buf_[bit / 8] |= (1u << (bit % 8));
@@ -391,6 +398,7 @@ class Engine {
     for (auto& kv : local_inflight_) {
       const Request& q = kv.second;
       if (!q.splits.empty()) continue;  // uneven alltoall never cache-served
+      if (q.type == RequestType::ALLGATHER) continue;  // see cache_bits()
       /* INVALID entries were already erased during ingest() — driven by
        * the global request stream so every rank erased identically; a
        * local-only erase here would desynchronize bit positions. */
@@ -500,7 +508,9 @@ class Engine {
     // mark scheduled tensors complete + populate the cache (uneven
     // alltoalls stay uncached: their recv_splits are call-specific)
     for (const TableEntry* e : schedulable) {
-      if (e->first.type != RequestType::BARRIER && e->splits_by_rank.empty()) {
+      if (e->first.type != RequestType::BARRIER &&
+          e->first.type != RequestType::ALLGATHER &&
+          e->splits_by_rank.empty()) {
         Response proto;
         proto.type = static_cast<ResponseType>(e->first.type);
         proto.dtype = e->first.dtype;
@@ -673,11 +683,14 @@ class Engine {
       e.error_message = os.str();
       return;
     }
-    if (q.type == RequestType::ALLTOALL && q.splits_crc != 0 &&
-        e.first.splits_crc != 0 && q.splits_crc != e.first.splits_crc) {
-      os << "Mismatched alltoall splits matrices for tensor " << e.first.name
-         << ": rank " << e.first_rank << " and rank " << rank
-         << " derived their splits rows from different matrices.";
+    bool crc_checked = q.type == RequestType::ALLTOALL ||
+                       q.type == RequestType::ALLGATHER;
+    if (crc_checked && q.splits_crc != 0 && e.first.splits_crc != 0 &&
+        q.splits_crc != e.first.splits_crc) {
+      os << "Mismatched " << request_type_name(q.type)
+         << " size metadata for tensor " << e.first.name << ": rank "
+         << e.first_rank << " and rank " << rank
+         << " derived their splits/dim0 rows from different matrices.";
       e.error_message = os.str();
       return;
     }
@@ -712,9 +725,12 @@ class Engine {
     for (const TableEntry* e : schedulable) {
       const Request& q = e->first;
       ResponseType rtype = static_cast<ResponseType>(q.type);
+      /* ALLGATHER left out of fusion: its response carries the per-rank
+       * first dims (ragged allgatherv, collective_operations.h:143-178)
+       * in recv_splits, which a joint response cannot represent per
+       * tensor. */
       bool fusable = q.type == RequestType::ALLREDUCE ||
                      q.type == RequestType::ADASUM ||
-                     q.type == RequestType::ALLGATHER ||
                      q.type == RequestType::BROADCAST;
       int64_t bytes = q.byte_size();
       if (!fusable) {
@@ -746,6 +762,18 @@ class Engine {
               r.recv_splits[j] =
                   static_cast<int32_t>(world_size_ ? d0 / world_size_ : 0);
             }
+          }
+        } else if (q.type == RequestType::ALLGATHER) {
+          /* Per-rank first dims (the ragged-allgather size exchange,
+           * collective_operations.h:143-178 displacement inputs): rank j
+           * contributes recv_splits[j] rows; ranks with no recorded dim
+           * (joined ranks) contribute zero rows. */
+          r.recv_splits.resize(world_size_);
+          for (int32_t j = 0; j < world_size_; ++j) {
+            auto dit = e->dim0_by_rank.find(j);
+            r.recv_splits[j] = dit == e->dim0_by_rank.end()
+                                   ? 0
+                                   : static_cast<int32_t>(dit->second);
           }
         }
         result.responses.push_back(std::move(r));

@@ -225,7 +225,7 @@ def test_engine_splits_matrix_digest_mismatch_symmetric():
         plans = drive_cycle(engines)
         for plan in plans:
             assert plan[0].is_error
-            assert "Mismatched alltoall splits matrices" in plan[0].error_message
+            assert "Mismatched ALLTOALL size metadata" in plan[0].error_message
     finally:
         for e in engines:
             e.close()

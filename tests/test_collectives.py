@@ -95,6 +95,74 @@ def test_allgather_scalars(hvd):
     np.testing.assert_allclose(np.asarray(out), np.arange(N, dtype=np.float32))
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+def test_allgather_ragged(hvd, dtype):
+    """Per-rank different first dims (the reference's allgatherv contract,
+    collective_operations.h:143-178): output concatenates each rank's
+    valid rows in rank order."""
+    d0s = [(i % 3) + 1 for i in range(N)]  # 1,2,3,1,2,3,...
+    vals = [jnp.full((d0s[i], 3), i, dtype) for i in range(N)]
+    bundle = hvd.per_rank(vals)
+    assert bundle.dim0s == tuple(d0s)
+    out = hvd.allgather(bundle)
+    assert out.shape == (sum(d0s), 3)
+    assert out.dtype == jnp.dtype(dtype)
+    off = 0
+    for i in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[off:off + d0s[i]], np.float64), i)
+        off += d0s[i]
+
+
+def test_allgather_ragged_zero_rows(hvd):
+    """A rank may contribute zero rows (the joined-rank contribution)."""
+    d0s = [2, 0, 1] + [1] * (N - 3)
+    vals = [jnp.full((d0s[i], 2), float(i + 1)) for i in range(N)]
+    out = hvd.allgather(hvd.per_rank(vals))
+    assert out.shape == (sum(d0s), 2)
+    np.testing.assert_allclose(np.asarray(out[:2]), 1.0)
+    np.testing.assert_allclose(np.asarray(out[2:3]), 3.0)  # rank 1 skipped
+
+
+def test_per_rank_ragged_trailing_dims_must_match(hvd):
+    with pytest.raises(ValueError, match="except the first"):
+        hvd.per_rank([jnp.ones((2, 3))] * (N - 1) + [jnp.ones((2, 4))])
+
+
+def test_ragged_bundle_rejected_by_uniform_ops(hvd):
+    """Ragged per_rank bundles must not slip zero padding into ops with
+    uniform-shape contracts (code-review r4): allreduce, broadcast,
+    reducescatter and even alltoall all reject them loudly."""
+    ragged = hvd.per_rank([jnp.ones((1 + (i % 2), 2)) for i in range(N)])
+    for op in (lambda: hvd.allreduce(ragged, op=hvd.Sum),
+               lambda: hvd.broadcast(ragged, 0),
+               lambda: hvd.reducescatter(ragged),
+               lambda: hvd.alltoall(ragged)):
+        with pytest.raises(ValueError, match="ragged"):
+            op()
+
+
+def test_alltoall_uneven_ragged_per_rank(hvd):
+    """Uneven alltoall accepts a ragged per_rank bundle: row sums are
+    validated against each rank's OWN first dim (ADVICE r3 #2)."""
+    d0s = [(i % 2) + 1 for i in range(N)]  # 1,2,1,2,...
+    vals = [jnp.arange(d0s[i] * 2, dtype=jnp.float32).reshape(d0s[i], 2)
+            + 10 * i for i in range(N)]
+    # rank i sends its single first row to rank 0, rest nowhere
+    smat = np.zeros((N, N), np.int64)
+    smat[:, 0] = 1
+    outs, recv = hvd.alltoall(hvd.per_rank(vals), splits=smat)
+    assert outs[0].shape == (N, 2)
+    for i in range(N):
+        np.testing.assert_allclose(np.asarray(outs[0][i]),
+                                   np.asarray(vals[i][0]))
+    # row sums beyond a rank's real rows must raise with the rank named
+    bad = np.zeros((N, N), np.int64)
+    bad[0, :2] = (1, 1)  # rank 0 only has 1 row
+    with pytest.raises(ValueError, match="rank 0's first dimension"):
+        hvd.alltoall(hvd.per_rank(vals), splits=bad)
+
+
 def test_broadcast_eager(hvd):
     vals = _rank_values()
     for root in (0, 3, 7):

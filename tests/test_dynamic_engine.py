@@ -147,6 +147,17 @@ class TestMismatchErrors:
         b.enqueue("g", REQ_ALLGATHER, shape=(5, 3))
         (resp,) = drive_cycle(world2)[0]
         assert not resp.is_error and resp.tensor_names == ["g"]
+        # the negotiated per-rank first dims ride recv_splits (the ragged
+        # allgatherv size exchange, collective_operations.h:143-178)
+        assert resp.recv_splits == [2, 5]
+
+    def test_allgather_dim0_digest_mismatch(self, world2):
+        a, b = world2
+        a.enqueue("g", REQ_ALLGATHER, shape=(2, 3), splits_crc=7)
+        b.enqueue("g", REQ_ALLGATHER, shape=(5, 3), splits_crc=8)
+        (err,) = drive_cycle(world2)[0]
+        assert err.is_error
+        assert "ALLGATHER size metadata" in err.error_message
 
     def test_allgather_later_dims_must_match(self, world2):
         a, b = world2
