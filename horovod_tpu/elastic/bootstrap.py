@@ -1,0 +1,97 @@
+"""Shared elastic-job wiring: KV server + driver + round-spec plumbing.
+
+One place for the infrastructure every elastic front end needs — the
+``hvdrun --min-np`` CLI (:mod:`horovod_tpu.elastic.launch`) and the Ray
+executor (:mod:`horovod_tpu.ray.elastic`) both stand up the same pieces:
+a signed KV server whose PUT observer feeds worker readiness/success into
+the driver, an :class:`ElasticRendezvous`, the
+:class:`~horovod_tpu.elastic.driver.ElasticDriver`, and the cached
+round-spec lookup worker spawners need. The reference splits the same
+roles between ``gloo_run_elastic`` and ``ElasticRayExecutor``
+(``/root/reference/horovod/runner/gloo_run.py:301-350``,
+``/root/reference/horovod/ray/elastic_v2.py``), duplicating the
+registration plumbing; here it is one helper.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from ..runner.http_kv import KVServer, local_addresses, make_secret
+from ..utils import envs
+from .driver import (
+    ROUND_SPEC_KEY,
+    ElasticDriver,
+    ElasticRendezvous,
+    parse_done_key,
+    parse_ready_key,
+)
+
+
+class ElasticInfra:
+    """The running pieces of one elastic job (driver side)."""
+
+    def __init__(self, kv: KVServer, kv_addr: str, kv_port: int,
+                 secret: str, driver: ElasticDriver):
+        self.kv = kv
+        self.kv_addr = kv_addr
+        self.kv_port = kv_port
+        self.secret = secret
+        self.driver = driver
+        self._spec_cache: dict[int, dict] = {}
+
+    def round_spec(self, spec_round: int) -> dict:
+        """The driver-published spec for a round (coordinator address,
+        world size, slot table) — what every worker spawner needs."""
+        if spec_round not in self._spec_cache:
+            self._spec_cache[spec_round] = pickle.loads(
+                self.kv.get(ROUND_SPEC_KEY.format(spec_round)))
+        return self._spec_cache[spec_round]
+
+    def worker_extra_env(self, spec_round: int,
+                         extra: dict | None = None) -> dict:
+        """The elastic additions to the launcher env contract."""
+        return {**(extra or {}), "HVD_ELASTIC": "1",
+                "HVD_ELASTIC_ROUND": str(spec_round)}
+
+    def stop(self) -> None:
+        self.driver.stop()
+        self.kv.stop()
+
+
+def make_elastic_infra(discovery, min_np: int, max_np: int | None = None,
+                       *, timeout: float | None = None,
+                       reset_limit: int | None = None,
+                       cooldown_range=None, verbose: int = 0,
+                       remote_port_probe=None) -> ElasticInfra:
+    """Stand up the KV server and elastic driver with the readiness/success
+    PUT observer wired (the protocol half of the reference's rendezvous
+    server: worker KV PUTs become ``driver.record_ready`` /
+    ``registry.record_success`` calls)."""
+    secret = make_secret()
+    driver_holder: list[ElasticDriver] = []
+
+    def on_put(key: str, _payload: bytes) -> None:
+        # Completion-by-KV decouples job success from the exit-code race
+        # during distributed-runtime teardown.
+        if not driver_holder:
+            return
+        parsed = parse_ready_key(key)
+        if parsed is not None:
+            driver_holder[0].record_ready(*parsed)
+            return
+        parsed = parse_done_key(key)
+        if parsed is not None:
+            driver_holder[0].registry.record_success(*parsed)
+
+    kv = KVServer(secret=secret, on_put=on_put)
+    kv_port = kv.start()
+    kv_addr = local_addresses()[0]
+
+    driver = ElasticDriver(
+        ElasticRendezvous(kv), discovery, min_np, max_np,
+        timeout=timeout or envs.get_int(envs.ELASTIC_TIMEOUT, 600),
+        reset_limit=reset_limit, cooldown_range=cooldown_range,
+        verbose=verbose, remote_port_probe=remote_port_probe)
+    driver_holder.append(driver)
+    return ElasticInfra(kv, kv_addr, kv_port, secret, driver)

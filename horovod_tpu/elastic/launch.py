@@ -13,15 +13,9 @@ import sys
 
 from ..runner import hosts as hosts_mod
 from ..runner import launch as launch_mod
-from ..runner.http_kv import KVServer, local_addresses, make_secret
 from ..utils import logging as hvd_logging
+from .bootstrap import make_elastic_infra
 from .discovery import FixedHosts, HostDiscoveryScript
-from .driver import (
-    ElasticDriver,
-    ElasticRendezvous,
-    parse_done_key,
-    parse_ready_key,
-)
 
 
 def _build_discovery(args):
@@ -39,34 +33,9 @@ def run_elastic(args, command: list[str]) -> int:
     max_np = args.max_np
     discovery = _build_discovery(args)
 
-    secret = make_secret()
-
-    driver_holder: list[ElasticDriver] = []
-
-    def on_put(key: str, _payload: bytes) -> None:
-        # Worker readiness and completion flow through KV PUTs (the
-        # reference's rendezvous server calls driver.record_ready the same
-        # way; completion-by-KV decouples job success from the exit-code
-        # race during distributed-runtime teardown).
-        if not driver_holder:
-            return
-        parsed = parse_ready_key(key)
-        if parsed is not None:
-            driver_holder[0].record_ready(*parsed)
-            return
-        parsed = parse_done_key(key)
-        if parsed is not None:
-            driver_holder[0].registry.record_success(*parsed)
-
-    kv = KVServer(secret=secret, on_put=on_put)
-    kv_port = kv.start()
-    kv_addr_candidates = local_addresses()
-    kv_addr = kv_addr_candidates[0]
-
-    rendezvous = ElasticRendezvous(kv)
     from ..utils import envs
-    driver = ElasticDriver(
-        rendezvous, discovery, min_np, max_np,
+    infra = make_elastic_infra(
+        discovery, min_np, max_np,
         # HVD_ELASTIC_TIMEOUT wins over the CLI default so driver and
         # workers agree on how long host replacement may take.
         timeout=envs.get_int(envs.ELASTIC_TIMEOUT, int(args.start_timeout)),
@@ -77,38 +46,25 @@ def run_elastic(args, command: list[str]) -> int:
         verbose=1 if args.verbose else 0,
         remote_port_probe=lambda host: launch_mod.probe_remote_free_port(
             host, args.ssh_port, args.ssh_identity_file))
-    driver_holder.append(driver)
+    driver = infra.driver
 
     extra_base = dict(args._config_env)
     for assignment in args.env:
         k, _, v = assignment.partition("=")
         extra_base[k] = v
 
-    spec_cache: dict[int, dict] = {}
-
-    def _round_spec(spec_round: int) -> dict:
-        import pickle
-
-        from .driver import ROUND_SPEC_KEY
-        if spec_round not in spec_cache:
-            spec_cache[spec_round] = pickle.loads(
-                kv.get(ROUND_SPEC_KEY.format(spec_round)))
-        return spec_cache[spec_round]
-
     def create_worker_fn(slot_info: hosts_mod.SlotInfo, spec_round: int):
-        spec = _round_spec(spec_round)
+        spec = infra.round_spec(spec_round)
         all_local = all(
             launch_mod.is_local_host(s["hostname"]) for s in spec["slots"])
         env = launch_mod.worker_env(
             slot_info,
             coordinator_addr=spec["coord_addr"],
             coordinator_port=spec["coord_port"],
-            kv_addr="127.0.0.1" if all_local else kv_addr,
-            kv_port=kv_port,
-            secret=secret,
-            extra={**extra_base,
-                   "HVD_ELASTIC": "1",
-                   "HVD_ELASTIC_ROUND": str(spec_round)})
+            kv_addr="127.0.0.1" if all_local else infra.kv_addr,
+            kv_port=infra.kv_port,
+            secret=infra.secret,
+            extra=infra.worker_extra_env(spec_round, extra_base))
         return launch_mod.spawn_worker(slot_info, command, env, args)
 
     try:
@@ -116,8 +72,7 @@ def run_elastic(args, command: list[str]) -> int:
         driver.join()
         results = driver.get_results()
     finally:
-        driver.stop()
-        kv.stop()
+        infra.stop()
 
     if results.error_message:
         print(f"hvdrun elastic: {results.error_message}", file=sys.stderr)

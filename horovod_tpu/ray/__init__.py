@@ -1,3 +1,4 @@
+from .elastic import ElasticRayExecutor, RayHostDiscovery
 from .runner import RayExecutor
 
-__all__ = ["RayExecutor"]
+__all__ = ["RayExecutor", "ElasticRayExecutor", "RayHostDiscovery"]

@@ -107,25 +107,9 @@ class RayExecutor:
             for w, slot in zip(self.workers, slots)])
 
     def _build_slots(self, ips: list) -> list:
-        """Rank assignment host-major, like the launcher's slot planner
-        (``hosts.py``): local ranks/sizes derive from actor colocation."""
-        by_host: dict = {}
-        for ip in ips:
-            by_host.setdefault(ip, 0)
-        host_order = list(by_host)
-        slots = []
-        local_counts: dict = {k: 0 for k in by_host}
-        for ip in ips:
-            local_counts[ip] += 1
-        seen: dict = {k: 0 for k in by_host}
-        for rank, ip in enumerate(ips):
-            slots.append(hosts_mod.SlotInfo(
-                hostname=ip, rank=rank, size=self.num_workers,
-                local_rank=seen[ip], local_size=local_counts[ip],
-                cross_rank=host_order.index(ip),
-                cross_size=len(host_order)))
-            seen[ip] += 1
-        return slots
+        """Rank assignment from actor colocation (shared planner,
+        ``hosts.slots_from_ips``)."""
+        return hosts_mod.slots_from_ips(ips)
 
     # -- execution ---------------------------------------------------------
 

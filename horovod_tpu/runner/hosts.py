@@ -147,3 +147,28 @@ def get_host_assignments(hosts: list[HostSpec], np: int) -> list[SlotInfo]:
                 cross_rank=cross_rank, cross_size=cross_size))
             rank += 1
     return assignments
+
+
+def slots_from_ips(ips: list) -> list[SlotInfo]:
+    """Rank assignment from an already-placed worker list (one IP per
+    rank, rank = list position): local ranks/sizes derive from colocation,
+    cross ranks from host order of first appearance. Shared by the Ray and
+    Spark integrations, where the cluster scheduler (not the launcher)
+    decided placement."""
+    n = len(ips)
+    host_order: list = []
+    local_counts: dict = {}
+    for ip in ips:
+        if ip not in local_counts:
+            local_counts[ip] = 0
+            host_order.append(ip)
+        local_counts[ip] += 1
+    seen: dict = {ip: 0 for ip in local_counts}
+    slots = []
+    for rank, ip in enumerate(ips):
+        slots.append(SlotInfo(
+            hostname=ip, rank=rank, size=n,
+            local_rank=seen[ip], local_size=local_counts[ip],
+            cross_rank=host_order.index(ip), cross_size=len(host_order)))
+        seen[ip] += 1
+    return slots

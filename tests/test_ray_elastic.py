@@ -1,0 +1,224 @@
+"""Elastic Ray executor tests with an in-process stub of the Ray API
+(Ray is not installed here; the reference's elastic_v2 tests run against
+local Ray). The stub runs "actors" as threads and lets the test mutate the
+cluster state, so the full elastic driver path is exercised: discovery
+from cluster state, a node dying mid-round, a replacement joining, and
+state re-sync through the KV — without jax world rebuilds (those are
+covered end-to-end by tests/test_integration_elastic.py)."""
+
+import pickle
+import threading
+import time
+import types
+
+import pytest
+
+import horovod_tpu.ray.elastic as ray_elastic
+from horovod_tpu.elastic.driver import (
+    ROUND_KEY,
+    ROUND_SPEC_KEY,
+    done_key,
+    ready_key,
+)
+from horovod_tpu.ray.elastic import ElasticRayExecutor, RayHostDiscovery
+
+
+class _Cluster:
+    """Mutable fake Ray cluster state."""
+
+    def __init__(self, hosts):
+        self.lock = threading.Lock()
+        self.hosts = dict(hosts)  # ip -> cpus (0 = dead)
+
+    def nodes(self):
+        with self.lock:
+            return [{"NodeManagerAddress": ip,
+                     "Alive": cpus > 0,
+                     "Resources": {"CPU": float(cpus)}}
+                    for ip, cpus in self.hosts.items()]
+
+    def kill(self, ip):
+        with self.lock:
+            self.hosts[ip] = 0
+
+    def add(self, ip, cpus=1):
+        with self.lock:
+            self.hosts[ip] = cpus
+
+
+class _Future:
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+    def resolve(self, value=None, error=None):
+        self.value, self.error = value, error
+        self.event.set()
+
+
+class _ActorMethod:
+    def __init__(self, bound):
+        self._bound = bound
+
+    def remote(self, *args, **kwargs):
+        fut = _Future()
+
+        def run():
+            try:
+                fut.resolve(value=self._bound(*args, **kwargs))
+            except BaseException as e:
+                fut.resolve(error=e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+class _ActorHandle:
+    def __init__(self, instance):
+        self._instance = instance
+
+    def __getattr__(self, name):
+        return _ActorMethod(getattr(self._instance, name))
+
+
+class _RemoteCls:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def options(self, **kwargs):
+        self.opts = kwargs
+        return self
+
+    def remote(self, *args, **kwargs):
+        return _ActorHandle(self._cls(*args, **kwargs))
+
+
+def _make_stub_ray(cluster):
+    ray = types.ModuleType("ray")
+    ray.is_initialized = lambda: True
+    ray.init = lambda *a, **k: None
+    ray.remote = lambda cls: _RemoteCls(cls)
+    ray.nodes = cluster.nodes
+    ray.kill = lambda actor: None
+
+    def ray_wait(refs, timeout=None):
+        (ref,) = refs
+        ok = ref.event.wait(timeout if timeout else None)
+        return ([ref], []) if ok else ([], [ref])
+
+    def ray_get(ref):
+        ref.event.wait()
+        if ref.error is not None:
+            raise ref.error
+        return ref.value
+
+    ray.wait = ray_wait
+    ray.get = ray_get
+    return ray
+
+
+def test_ray_host_discovery_parses_cluster_state():
+    cluster = _Cluster({"10.0.0.1": 4, "10.0.0.2": 2, "10.0.0.3": 0})
+    disc = RayHostDiscovery(_make_stub_ray(cluster), cpus_per_worker=2)
+    assert disc.find_available_hosts_and_slots() == {
+        "10.0.0.1": 2, "10.0.0.2": 1}
+    # custom resources bound the slot count too
+    cluster2 = _Cluster({"10.0.0.1": 8})
+    ray2 = _make_stub_ray(cluster2)
+    disc2 = RayHostDiscovery(ray2, cpus_per_worker=1,
+                             resources_per_worker={"TPU": 1})
+    assert disc2.find_available_hosts_and_slots() == {}  # no TPU resource
+
+
+def test_elastic_ray_node_death_and_replacement(monkeypatch):
+    """The headline scenario (reference elastic_v2.py): a worker's node
+    dies mid-round; the driver blacklists it, discovery reports a
+    replacement, a new round starts, the surviving worker re-registers
+    in-process and the replacement picks up synced state through the KV."""
+    cluster = _Cluster({"10.0.0.1": 1, "10.0.0.2": 1})
+    ray = _make_stub_ray(cluster)
+    monkeypatch.setitem(__import__("sys").modules, "ray", ray)
+
+    # stub worker class: passes the seeded env dict straight to fn so the
+    # in-process threads don't race on a shared os.environ
+    def stub_cls_factory(_ray):
+        class _W:
+            def execute(self, env, fn, args, kwargs):
+                try:
+                    return ("ok", fn(env, *args, **(kwargs or {})))
+                except SystemExit as e:
+                    return ("exit", int(e.code or 0))
+
+        return _W
+
+    monkeypatch.setattr(ray_elastic, "_make_elastic_worker_cls",
+                        stub_cls_factory)
+
+    from horovod_tpu.runner.http_kv import KVClient
+
+    def worker_fn(env):
+        kv = KVClient(env["HVD_KV_ADDR"], int(env["HVD_KV_PORT"]),
+                      secret=env["HVD_SECRET_KEY"])
+        host = env["HVD_HOSTNAME"]
+        slot = int(env["HVD_LOCAL_RANK"])
+        rnd = int(env["HVD_ELASTIC_ROUND"])
+        kv.put(ready_key(rnd, host, slot), b"1")
+
+        if host == "10.0.0.2":
+            # this node dies: cluster state flips AND the actor errors,
+            # and a replacement node appears for discovery to find
+            cluster.kill("10.0.0.2")
+            cluster.add("10.0.0.3")
+            raise RuntimeError("node lost")
+
+        if rnd == 1:
+            # survivor: wait for the driver to publish the next round,
+            # re-register in-process (the subprocess analog of
+            # WorkerRendezvous.reset), and publish state for newcomers
+            deadline = time.monotonic() + 60
+            while True:
+                raw = kv.get(ROUND_KEY)
+                if raw is not None and int(raw) > 1:
+                    new_round = int(raw)
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError("no new round")
+                time.sleep(0.1)
+            spec = pickle.loads(kv.get(ROUND_SPEC_KEY.format(new_round)))
+            assert any(s["hostname"] == "10.0.0.3" for s in spec["slots"])
+            kv.put("test/state", b"step=7")
+            kv.put(ready_key(new_round, host, slot), b"1")
+        else:
+            # replacement worker: joins the new round and syncs state
+            deadline = time.monotonic() + 60
+            while kv.get("test/state") is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("state never synced")
+                time.sleep(0.1)
+            assert kv.get("test/state") == b"step=7"
+
+        kv.put(done_key(host, slot), b"1")
+        return f"{host}/{slot}"
+
+    ex = ElasticRayExecutor(min_workers=2, elastic_timeout=60)
+    ex.start()
+    try:
+        results = ex.run(worker_fn)
+    finally:
+        ex.shutdown()
+    # survivor and replacement finished; the dead node's worker did not
+    assert sorted(results) == ["10.0.0.1/0", "10.0.0.3/0"]
+
+
+def test_elastic_ray_requires_start():
+    ex = ElasticRayExecutor(min_workers=1)
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(lambda env: None)
+
+
+def test_module_imports_without_ray(monkeypatch):
+    monkeypatch.setitem(__import__("sys").modules, "ray", None)
+    ex = ElasticRayExecutor(min_workers=1)
+    with pytest.raises((ImportError, RuntimeError)):
+        ex.start()

@@ -1,0 +1,183 @@
+"""Spark run() tests with an in-process stub of the pyspark barrier API
+(pyspark is not installed here; the reference tests run on a local Spark,
+``test/integration/test_spark.py`` — the stub checks the same contract:
+barrier scheduling of num_proc tasks, allGather address exchange, launcher
+env seeding, rank-ordered results, timeout cancellation)."""
+
+import os
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+import horovod_tpu.spark as hvd_spark
+
+
+class _Comm:
+    """allGather across the stub's task threads."""
+
+    def __init__(self, n):
+        self.barrier = threading.Barrier(n)
+        self.msgs = [None] * n
+
+    def all_gather(self, rank, msg):
+        self.msgs[rank] = msg
+        self.barrier.wait()
+        out = list(self.msgs)
+        self.barrier.wait()
+        return out
+
+
+class _StubBarrierContext:
+    _local = threading.local()
+
+    def __init__(self, rank, comm):
+        self._rank = rank
+        self._comm = comm
+
+    @classmethod
+    def get(cls):
+        return cls._local.ctx
+
+    def partitionId(self):
+        return self._rank
+
+    def allGather(self, msg):
+        return self._comm.all_gather(self._rank, msg)
+
+
+class _StubRDD:
+    def __init__(self, n, hang=False):
+        self.n = n
+        self.hang = hang
+
+    def barrier(self):
+        return self
+
+    def mapPartitions(self, task):
+        self._task = task
+        return self
+
+    def collect(self):
+        if self.hang:  # simulate tasks never getting scheduled
+            threading.Event().wait(30)
+            return []
+        comm = _Comm(self.n)
+        results = [None] * self.n
+        errors = []
+
+        def runner(rank):
+            _StubBarrierContext._local.ctx = _StubBarrierContext(rank, comm)
+            try:
+                results[rank] = list(self._task(iter(())))
+            except BaseException as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+                   for r in range(self.n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if errors:
+            raise errors[0]
+        out = []
+        for part in results:
+            out.extend(part or [])
+        return out
+
+
+class _StubSparkContext:
+    def __init__(self, default_parallelism=2):
+        self.defaultParallelism = default_parallelism
+        self.cancelled = []
+        self.hang_tasks = False
+
+    def setJobGroup(self, group, desc, interruptOnCancel=False):
+        self.group = group
+
+    def cancelJobGroup(self, group):
+        self.cancelled.append(group)
+
+    def parallelize(self, data, n):
+        return _StubRDD(n, hang=self.hang_tasks)
+
+
+@pytest.fixture()
+def stub_pyspark(monkeypatch):
+    sc = _StubSparkContext()
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = types.SimpleNamespace(_active_spark_context=sc)
+    mod.BarrierTaskContext = _StubBarrierContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    before = dict(os.environ)
+    yield sc
+    for k in [k for k in os.environ if k.startswith("HVD_")
+              and k not in before]:
+        del os.environ[k]
+
+
+def test_spark_run_returns_rank_ordered_results(stub_pyspark):
+    results = hvd_spark.run(lambda x: x * 2, args=(21,), num_proc=3)
+    assert results == [42, 42, 42]
+
+
+def test_spark_run_default_num_proc(stub_pyspark):
+    results = hvd_spark.run(lambda: "ok")
+    assert len(results) == stub_pyspark.defaultParallelism
+
+
+def test_spark_run_seeds_launcher_env(stub_pyspark):
+    envs = hvd_spark.run(
+        lambda: {k: v for k, v in os.environ.items()
+                 if k.startswith("HVD_") or k == "MY_FLAG"},
+        num_proc=2, env={"MY_FLAG": "7"})
+    for env in envs:
+        assert env["HVD_SIZE"] == "2"
+        assert env["HVD_NUM_PROCESSES"] == "2"
+        assert env["HVD_KV_ADDR"]
+        assert env["HVD_KV_PORT"]
+        assert env["HVD_COORDINATOR_ADDR"]
+        assert env["HVD_COORDINATOR_PORT"] != "0"
+        assert env["HVD_SECRET_KEY"]
+        assert env["MY_FLAG"] == "7"
+
+
+def test_spark_run_propagates_worker_errors(stub_pyspark):
+    def boom():
+        raise ValueError("rank exploded")
+
+    with pytest.raises(ValueError, match="rank exploded"):
+        hvd_spark.run(boom, num_proc=2)
+
+
+def test_spark_run_timeout_covers_startup_only(stub_pyspark):
+    """start_timeout bounds task SCHEDULING, never training: a fn slower
+    than the timeout still completes once every task registered."""
+    results = hvd_spark.run(lambda: time.sleep(1.0) or "slow-ok",
+                            num_proc=2, start_timeout=0.3)
+    assert results == ["slow-ok", "slow-ok"]
+
+
+def test_spark_run_timeout_cancels_unscheduled_job(stub_pyspark):
+    stub_pyspark.hang_tasks = True  # tasks never start -> no registration
+    with pytest.raises(TimeoutError, match="barrier"):
+        hvd_spark.run(lambda: 1, num_proc=2, start_timeout=0.3)
+    assert stub_pyspark.cancelled  # the spark job group was cancelled
+
+
+def test_spark_run_requires_active_context(monkeypatch):
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = types.SimpleNamespace(_active_spark_context=None)
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    with pytest.raises(RuntimeError, match="SparkContext"):
+        hvd_spark.run(lambda: 1, num_proc=1)
+
+
+def test_module_imports_without_pyspark(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pyspark", None)
+    # importing horovod_tpu.spark must not need pyspark; only run() does
+    with pytest.raises(ImportError):
+        hvd_spark.run(lambda: 1, num_proc=1)
