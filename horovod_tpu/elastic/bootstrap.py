@@ -90,7 +90,10 @@ def make_elastic_infra(discovery, min_np: int, max_np: int | None = None,
 
     driver = ElasticDriver(
         ElasticRendezvous(kv), discovery, min_np, max_np,
-        timeout=timeout or envs.get_int(envs.ELASTIC_TIMEOUT, 600),
+        # `is not None`, not `or`: an explicit timeout of 0 means fail
+        # fast, which the 600 s default must not swallow
+        timeout=(timeout if timeout is not None
+                 else envs.get_int(envs.ELASTIC_TIMEOUT, 600)),
         reset_limit=reset_limit, cooldown_range=cooldown_range,
         verbose=verbose, remote_port_probe=remote_port_probe)
     driver_holder.append(driver)

@@ -198,6 +198,8 @@ class ElasticRayExecutor:
         if self._ray is None:
             raise RuntimeError("ElasticRayExecutor.start() has not been "
                                "called")
+        with self._handles_lock:
+            self._handles.clear()  # a prior run()'s workers must not leak
         from ..elastic.bootstrap import make_elastic_infra
 
         discovery = self._override_discovery or RayHostDiscovery(

@@ -203,6 +203,30 @@ class TestSubsetTracedCollectives:
         finally:
             hvd.remove_process_set(ps)
 
+    def test_subset_product_ring_odd_sizes(self, hvd):
+        """Product ring with k=3 members and an element count not
+        divisible by k (exercises chunk padding with the multiplicative
+        identity) plus an int dtype for exactness."""
+        import numpy as np
+        n = hvd.size()
+        if n < 4:
+            import pytest
+            pytest.skip("needs 4 devices")
+        ps = hvd.add_process_set([0, 1, 3])
+        try:
+            data = np.ones((n, 5), np.int32)  # 5 % 3 != 0
+            data[0] = [2, 1, 3, 1, 2]
+            data[1] = [3, 2, 1, 5, 1]
+            data[3] = [1, 4, 2, 1, 7]
+            out = self._run(hvd, lambda x: hvd.allreduce(
+                x[0], op=hvd.Product, process_set=ps)[None], data)
+            expect = data[0] * data[1] * data[3]
+            for r in (0, 1, 3):
+                assert np.array_equal(out[r].astype(np.int64), expect), \
+                    (r, out[r])
+        finally:
+            hvd.remove_process_set(ps)
+
     def test_subset_product_nonmember_keeps_value(self, hvd):
         import numpy as np
         n = hvd.size()

@@ -104,7 +104,8 @@ def _make_stub_ray(cluster):
 
     def ray_wait(refs, timeout=None):
         (ref,) = refs
-        ok = ref.event.wait(timeout if timeout else None)
+        # timeout=0 is a non-blocking poll in real Ray — preserve that
+        ok = ref.event.wait(timeout if timeout is not None else None)
         return ([ref], []) if ok else ([], [ref])
 
     def ray_get(ref):
