@@ -104,8 +104,9 @@ def _make_stub_ray(cluster):
 
     def ray_wait(refs, timeout=None):
         (ref,) = refs
-        # timeout=0 is a non-blocking poll in real Ray — preserve that
-        ok = ref.event.wait(timeout if timeout is not None else None)
+        # Event.wait matches real Ray's semantics directly: None blocks
+        # forever, 0 is a non-blocking poll
+        ok = ref.event.wait(timeout)
         return ([ref], []) if ok else ([], [ref])
 
     def ray_get(ref):
