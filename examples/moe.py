@@ -2,14 +2,12 @@
 """Mixture-of-Experts with expert parallelism over the mesh.
 
 Demonstrates the parallelism row SURVEY.md §2.3 marks "primitive only" in
-the reference: expert parallelism built from the framework's alltoall.
-One expert lives on each chip; every chip routes its tokens to their
-top-1 expert with a capacity-bounded dispatch, exchanges them with
-``lax.all_to_all`` over the mesh axis (the traced-mode path of
-``hvd.alltoall``), runs its expert FFN on the tokens it received, and
-routes the outputs back with the inverse alltoall. Gradients data-sync
-with the usual mesh reduction, so MoE training drops into the standard
-loop.
+the reference, now first-class here: one expert lives on each chip, and
+``horovod_tpu.parallel.moe_alltoall`` routes every chip's tokens to their
+top-1 expert (capacity-bounded Switch-style dispatch), exchanges them
+over the mesh axis with one alltoall each way, and gate-combines the
+outputs. Gradients data-sync with the usual mesh reduction, so MoE
+training drops into the standard loop.
 
 Run (single host, virtual 8-chip mesh = 8 experts):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -29,51 +27,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import horovod_tpu as hvd
 
 
-def moe_layer(params, x, axis, n_expert, capacity):
-    """x: (tokens, d) on this chip. Top-1 routing, capacity C per
-    (src chip, expert) pair — static shapes, overflow tokens dropped
-    (standard Switch-style dispatch)."""
-    tokens, d = x.shape
+def moe_layer(params, x, axis, capacity):
+    """x: (tokens, d) on this chip. Routing, the capacity-bounded
+    alltoall dispatch/combine, and the load-balance loss all come from
+    the framework (:func:`horovod_tpu.parallel.moe_alltoall`); the
+    example supplies only the router projection and this chip's expert
+    FFN."""
     logits = x @ params["router"]                    # (tokens, n_expert)
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)              # (tokens,)
-    gate = jnp.max(probs, axis=-1)                   # (tokens,)
 
-    # position of each token within its expert's capacity bucket
-    onehot = jax.nn.one_hot(expert, n_expert, dtype=jnp.int32)
-    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot
-    pos = jnp.sum(pos_in_expert, axis=-1)            # (tokens,)
-    keep = pos < capacity
+    def expert_fn(t):  # this chip's expert on the tokens it received
+        return jax.nn.relu(t @ params["w_in"]) @ params["w_out"]
 
-    # dispatch buffer: (n_expert, capacity, d); dropped tokens stay zero
-    dispatch = jnp.zeros((n_expert, capacity, d), x.dtype)
-    dispatch = dispatch.at[expert, pos].add(
-        jnp.where(keep[:, None], x, 0.0))
-
-    # exchange (shape-preserving tiled alltoall): chip e's row s is now
-    # the bucket chip s addressed to expert e — (n_expert, capacity, d),
-    # axis 0 indexing source chips after the exchange
-    recv = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0,
-                          tiled=True)
-
-    # this chip's expert FFN on everything it received (batched over the
-    # leading source-chip axis)
-    h = jax.nn.relu(recv @ params["w_in"])
-    out = h @ params["w_out"]                        # (n_expert, cap, d)
-
-    # route back: the inverse alltoall returns each chip's own buckets,
-    # axis 0 indexing experts again
-    back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
-                          tiled=True)
-
-    # gather each token's output from (its expert bucket, its position)
-    y = back[expert, pos] * jnp.where(keep, gate, 0.0)[:, None]
-
-    # load-balancing auxiliary loss (Switch Transformer eq. 4)
-    frac_tokens = jnp.mean(onehot.astype(x.dtype), axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
-    aux = n_expert * jnp.sum(frac_tokens * frac_probs)
-    return y, aux
+    return hvd.parallel.moe_alltoall(x, logits, expert_fn, axis,
+                                     k=1, capacity=capacity)
 
 
 def main():
@@ -110,7 +76,7 @@ def main():
     opt_state = tx.init(params)
 
     def loss_fn(p, xb, yb):
-        out, aux = moe_layer(p, xb, axis, n, capacity)
+        out, aux = moe_layer(p, xb, axis, capacity)
         return jnp.mean((out - yb) ** 2) + 0.01 * aux
 
     def step(p, o, xb, yb):

@@ -13,8 +13,12 @@ package makes the schedules themselves first-class for TPU:
   all-to-all switches) — DeepSpeed-Ulysses-style sequence parallelism:
   resharding from sequence-parallel to head-parallel and back with two
   ``lax.all_to_all``\\ s, running exact full-sequence attention locally.
+* :func:`moe_alltoall` (+ :func:`route_top_k`, :func:`load_balance_loss`)
+  — expert parallelism: capacity-bounded top-k MoE dispatch/combine over
+  one alltoall each way, one expert group per chip.
 """
 
+from .moe import load_balance_loss, moe_alltoall, route_top_k
 from .sequence import (
     heads_to_seq,
     ring_attention,
@@ -23,4 +27,5 @@ from .sequence import (
 )
 
 __all__ = ["ring_attention", "ulysses_attention", "seq_to_heads",
-           "heads_to_seq"]
+           "heads_to_seq", "moe_alltoall", "route_top_k",
+           "load_balance_loss"]

@@ -1,0 +1,115 @@
+"""Expert parallelism: Mixture-of-Experts dispatch/combine over alltoall.
+
+The reference stops at the ``alltoall`` primitive (``operations.cc:1642``)
+— SURVEY.md §2.3 marks expert parallelism "primitive only". This module
+makes the MoE schedule itself first-class: top-k routing, a
+capacity-bounded dispatch (Switch/GShard style — static shapes, overflow
+tokens dropped), one shape-preserving ``lax.all_to_all`` to move each
+token to its expert's chip, the expert computation on local tokens, the
+inverse exchange, and the gate-weighted combine. One expert group lives
+on each chip of the mesh axis; everything runs inside ``jax.shard_map``
+and differentiates end-to-end (router gradients flow through the gate
+weighting, the standard trick).
+
+    def expert_fn(tokens):           # (N, d) on this chip's expert
+        return nn.relu(tokens @ w_in) @ w_out
+
+    y, aux = moe_alltoall(x, router_logits, expert_fn, axis)
+    loss = task_loss(y) + 0.01 * aux  # Switch load-balance auxiliary
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def route_top_k(router_logits, k: int = 1):
+    """Top-k routing: returns ``(expert_idx, gates)`` of shape
+    (tokens, k). For k=1 the gate is the RAW top softmax probability
+    (Switch Transformer convention) — renormalizing would make it
+    identically 1 and sever the router's task-loss gradient; for k>1
+    the k gates are renormalized to a convex blend (GShard convention),
+    through which router gradients still flow."""
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gates, expert_idx = lax.top_k(probs, k)
+    if k > 1:
+        gates = gates / jnp.maximum(jnp.sum(gates, -1, keepdims=True),
+                                    1e-9)
+    return expert_idx, gates
+
+
+def load_balance_loss(router_logits, expert_idx) -> jax.Array:
+    """Switch Transformer auxiliary loss (eq. 4): n_expert times the dot
+    of (fraction of tokens routed to e, mean router probability of e) —
+    minimized by a uniform assignment."""
+    n_expert = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    onehot = jax.nn.one_hot(expert_idx[..., 0], n_expert,
+                            dtype=probs.dtype)  # primary expert
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    return n_expert * jnp.sum(frac_tokens * frac_probs)
+
+
+def moe_alltoall(x, router_logits, expert_fn: Callable, axis, *,
+                 k: int = 1, capacity: int | None = None,
+                 capacity_factor: float = 1.25):
+    """Route this chip's tokens through the mesh's experts and back.
+
+    Inside ``shard_map`` with one expert (group) per chip of ``axis``:
+    ``x`` (tokens, d) and ``router_logits`` (tokens, n_expert) are this
+    chip's shard; ``expert_fn`` maps (N, d) -> (N, d_out) using THIS
+    chip's expert parameters. Returns ``(y, aux)`` where ``y``
+    (tokens, d_out) is the gate-weighted combine of each token's k expert
+    outputs (dropped overflow tokens contribute zero, as in
+    Switch/GShard) and ``aux`` the load-balance loss.
+
+    ``capacity`` bounds tokens per (source chip, expert) pair; default
+    ``ceil(capacity_factor * k * tokens / n_expert)``.
+    """
+    tokens, d = x.shape
+    n_expert = int(lax.psum(1, axis))
+    if router_logits.shape != (tokens, n_expert):
+        raise ValueError(
+            f"router_logits shape {router_logits.shape} != "
+            f"({tokens}, axis size {n_expert})")
+    if capacity is None:
+        need = capacity_factor * k * tokens
+        capacity = max(-(-int(need) // n_expert), 4)  # true ceil
+
+    expert_idx, gates = route_top_k(router_logits, k)
+
+    # flatten the (token, pick) pairs and slot each into its expert's
+    # capacity bucket in routing-priority order (pick 0 first)
+    flat_expert = expert_idx.T.reshape(-1)          # (k*tokens,) pick-major
+    flat_token = jnp.tile(jnp.arange(tokens), k)
+    flat_gate = gates.T.reshape(-1)
+    onehot = jax.nn.one_hot(flat_expert, n_expert, dtype=jnp.int32)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
+    keep = pos < capacity
+    pos = jnp.minimum(pos, capacity - 1)
+
+    dispatch = jnp.zeros((n_expert, capacity, d), x.dtype)
+    dispatch = dispatch.at[flat_expert, pos].add(
+        jnp.where(keep[:, None], x[flat_token], 0))
+
+    # exchange: row s of this chip's buffer is now the bucket chip s
+    # addressed to this chip's expert
+    recv = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0,
+                          tiled=True)               # (n_src, capacity, d)
+    out = expert_fn(recv.reshape(n_expert * capacity, d))
+    d_out = out.shape[-1]
+    out = out.reshape(n_expert, capacity, d_out)
+
+    # inverse exchange: each chip's buckets come home, expert-major again
+    back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                          tiled=True)               # (n_expert, cap, d_out)
+
+    picked = back[flat_expert, pos] * \
+        jnp.where(keep, flat_gate, 0)[:, None]      # (k*tokens, d_out)
+    y = jnp.sum(picked.reshape(k, tokens, d_out), axis=0)
+    return y, load_balance_loss(router_logits, expert_idx)
