@@ -1,8 +1,11 @@
+from .inception import InceptionV3
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
 from .sync_batch_norm import SyncBatchNorm
 from .transformer import TransformerConfig, TransformerLM, param_shardings
+from .vgg import VGG, VGG16, VGG19
 
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+    "VGG", "VGG16", "VGG19", "InceptionV3",
     "SyncBatchNorm", "TransformerConfig", "TransformerLM", "param_shardings",
 ]

@@ -1,0 +1,160 @@
+"""Inception V3 in flax, TPU-first.
+
+The third of the reference's published scaling-efficiency models
+(``/root/reference/docs/benchmarks.rst:13-14``: Inception V3 at 90% on
+512 GPUs). Architecture per Szegedy et al. 2015 ("Rethinking the
+Inception Architecture", the V3 configuration): factorized 7x7 branches,
+grid reductions, 299x299 native input (any HxW >= 75 works — global
+pooling at the head). NHWC, bfloat16 compute, float32
+parameters/batch-norm.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    padding: str | tuple = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _pool_avg(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(64, (5, 5))(c(48, (1, 1))(x, train), train)
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x, train), train),
+                           train)
+        b4 = c(self.pool_features, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (3, 3), (2, 2), "VALID")(x, train)
+        b2 = c(96, (3, 3), (2, 2), "VALID")(
+            c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Factorized 7x7 branches (the V3 signature block)."""
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        cc = self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = c(192, (7, 1))(c(cc, (1, 7))(c(cc, (1, 1))(x, train), train),
+                            train)
+        b3 = x
+        for k, ch in (((1, 1), cc), ((7, 1), cc), ((1, 7), cc),
+                      ((7, 1), cc), ((1, 7), 192)):
+            b3 = c(ch, k)(b3, train)
+        b4 = c(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (3, 3), (2, 2), "VALID")(c(192, (1, 1))(x, train),
+                                             train)
+        b2 = c(192, (1, 1))(x, train)
+        b2 = c(192, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b2 = c(192, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Expanded-filter-bank output blocks (8x8 grid)."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([c(384, (1, 3))(b2, train),
+                              c(384, (3, 1))(b2, train)], axis=-1)
+        b3 = c(448, (1, 1))(x, train)
+        b3 = c(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([c(384, (1, 3))(b3, train),
+                              c(384, (3, 1))(b3, train)], axis=-1)
+        b4 = c(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem
+        x = c(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = c(32, (3, 3), padding="VALID")(x, train)
+        x = c(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = c(80, (1, 1), padding="VALID")(x, train)
+        x = c(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 35x35
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = ReductionA(self.dtype)(x, train)
+        # 17x17
+        x = InceptionB(128, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(192, self.dtype)(x, train)
+        x = ReductionB(self.dtype)(x, train)
+        # 8x8
+        x = InceptionC(self.dtype)(x, train)
+        x = InceptionC(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,49 @@
+"""VGG family in flax, TPU-first.
+
+One of the reference's three published scaling-efficiency models
+(``/root/reference/docs/benchmarks.rst:13-14``: VGG-16 at 68% on 512
+GPUs — the hard case, its large dense layers stress allreduce
+bandwidth, which is exactly why it belongs in the scaling harness).
+NHWC layout, bfloat16 compute with float32 parameters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# convs per stage; channels double per stage from 64 to 512
+VGG16_STAGES = (2, 2, 3, 3, 3)
+VGG19_STAGES = (2, 2, 4, 4, 4)
+
+
+class VGG(nn.Module):
+    stage_sizes: Sequence[int] = VGG16_STAGES
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    classifier_width: int = 4096
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for i, reps in enumerate(self.stage_sizes):
+            ch = min(64 * 2 ** i, 512)
+            for _ in range(reps):
+                x = nn.relu(conv(ch)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+        x = nn.relu(dense(self.classifier_width)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(dense(self.classifier_width)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return dense(self.num_classes)(x).astype(jnp.float32)
+
+
+VGG16 = partial(VGG, stage_sizes=VGG16_STAGES)
+VGG19 = partial(VGG, stage_sizes=VGG19_STAGES)

@@ -69,7 +69,8 @@ def moe_alltoall(x, router_logits, expert_fn: Callable, axis, *,
     Switch/GShard) and ``aux`` the load-balance loss.
 
     ``capacity`` bounds tokens per (source chip, expert) pair; default
-    ``ceil(capacity_factor * k * tokens / n_expert)``.
+    ``ceil(capacity_factor * k * tokens / n_expert)``, floored at 4 so
+    tiny shards keep a usable bucket.
     """
     tokens, d = x.shape
     n_expert = int(lax.psum(1, axis))
@@ -78,8 +79,9 @@ def moe_alltoall(x, router_logits, expert_fn: Callable, axis, *,
             f"router_logits shape {router_logits.shape} != "
             f"({tokens}, axis size {n_expert})")
     if capacity is None:
-        need = capacity_factor * k * tokens
-        capacity = max(-(-int(need) // n_expert), 4)  # true ceil
+        import math
+        capacity = max(math.ceil(capacity_factor * k * tokens / n_expert),
+                       4)
 
     expert_idx, gates = route_top_k(router_logits, k)
 

@@ -33,7 +33,35 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def child_main(n: int, mode: str, total_batch: int, iters: int) -> None:
+MODEL_DESCRIPTIONS = {
+    "resnet": "ResNet18/32x32",
+    "vgg": "VGG16(classifier_width=256)/32x32",
+    "inception": "InceptionV3/75x75",
+}
+
+
+def _make_model(name: str):
+    """The reference's three published scaling models, in small-input
+    form (docs/benchmarks.rst:13-14 runs ResNet-101/Inception-V3/VGG-16;
+    the virtual-CPU harness uses the light family members so the signal
+    is collective overhead, not CPU conv time)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import models as M
+
+    if name == "resnet":
+        return M.ResNet18(num_classes=10, dtype=jnp.float32,
+                          axis_name=None), 32
+    if name == "vgg":
+        return M.VGG16(num_classes=10, dtype=jnp.float32,
+                       classifier_width=256), 32
+    if name == "inception":
+        return M.InceptionV3(num_classes=10, dtype=jnp.float32), 75
+    raise ValueError(f"unknown model {name!r}")
+
+
+def child_main(n: int, mode: str, total_batch: int, iters: int,
+               model_name: str = "resnet") -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -41,31 +69,35 @@ def child_main(n: int, mode: str, total_batch: int, iters: int) -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import ResNet18
     from horovod_tpu.ops import hierarchical
 
     hvd.init()  # collective layer resolves the (global) process set
     devs = jax.devices()[:n]
     # local (non-sync) batch norm, matching the reference benchmark's
     # semantics — gradient allreduce is the only cross-device traffic
-    model = ResNet18(num_classes=10, dtype=jnp.float32, axis_name=None)
+    model, side = _make_model(model_name)
     rng = jax.random.PRNGKey(0)
     images = np.random.default_rng(0).standard_normal(
-        (total_batch, 32, 32, 3), dtype=np.float32)
+        (total_batch, side, side, 3), dtype=np.float32)
     labels = np.random.default_rng(1).integers(0, 10, size=(total_batch,))
 
-    variables = model.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32),
-                           train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    variables = model.init(rng, jnp.zeros((1, side, side, 3), jnp.float32),
+                           train=False)
+    params = variables["params"]
+    # VGG has no batch norm; ResNet/Inception do. Eval-mode apply keeps
+    # the loss generic (the harness measures collective overhead, not
+    # batch-norm bookkeeping) — stats ride along untouched.
+    batch_stats = dict(variables.get("batch_stats", {}))
     inner = optax.sgd(0.05, momentum=0.9)
 
     def loss_fn(p, batch_stats, images, labels):
-        logits, mutated = model.apply(
-            {"params": p, "batch_stats": batch_stats}, images, train=True,
-            mutable=["batch_stats"])
+        vars_in = {"params": p}
+        if batch_stats:
+            vars_in["batch_stats"] = batch_stats
+        logits = model.apply(vars_in, images, train=False)
         one_hot = jax.nn.one_hot(labels, 10)
         loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), -1))
-        return loss, mutated["batch_stats"]
+        return loss, batch_stats
 
     if mode == "flat":
         mesh = Mesh(np.array(devs), ("data",))
@@ -130,7 +162,7 @@ def child_main(n: int, mode: str, total_batch: int, iters: int) -> None:
 
 
 def run_child(n: int, mode: str, total_batch: int, iters: int,
-              max_devices: int) -> dict:
+              max_devices: int, model: str = "resnet") -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -141,7 +173,7 @@ def run_child(n: int, mode: str, total_batch: int, iters: int,
             env.pop(k)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--_child",
-         str(n), mode, str(total_batch), str(iters)],
+         str(n), mode, str(total_batch), str(iters), model],
         env=env, cwd=HERE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, timeout=1800)
     if proc.returncode != 0:
@@ -152,16 +184,21 @@ def run_child(n: int, mode: str, total_batch: int, iters: int,
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--_child", nargs=4, metavar=("N", "MODE", "BATCH", "ITERS"))
+    parser.add_argument("--_child", nargs=5,
+                        metavar=("N", "MODE", "BATCH", "ITERS", "MODEL"))
     parser.add_argument("--devices", default="1,2,4,8")
     parser.add_argument("--total-batch", type=int, default=64)
     parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--model", default="resnet",
+                        choices=("resnet", "vgg", "inception"),
+                        help="the reference's three published scaling "
+                             "models (docs/benchmarks.rst:13-14)")
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
 
     if args._child:
-        n, mode, batch, iters = args._child
-        child_main(int(n), mode, int(batch), int(iters))
+        n, mode, batch, iters, model = args._child
+        child_main(int(n), mode, int(batch), int(iters), model)
         return
 
     device_counts = [int(x) for x in args.devices.split(",")]
@@ -172,7 +209,8 @@ def main():
     for n in device_counts:
         modes = ["flat"] if n == 1 else ["nosync", "flat", "hier"]
         for mode in modes:
-            r = run_child(n, mode, args.total_batch, args.iters, max_devices)
+            r = run_child(n, mode, args.total_batch, args.iters,
+                          max_devices, args.model)
             if base_ms is None:
                 base_ms = r["step_ms"]
             if mode == "nosync":
@@ -187,10 +225,10 @@ def main():
             results.append(r)
             print(json.dumps(r))
 
-    out = args.out or os.path.join(HERE, "SCALING_r3.json")
+    out = args.out or os.path.join(HERE, f"SCALING_{args.model}_r4.json")
     payload = {
         "harness": "fixed-total-work strong scaling on virtual CPU devices",
-        "model": "ResNet18/32x32",
+        "model": MODEL_DESCRIPTIONS[args.model],
         "total_batch": args.total_batch,
         "metric": "efficiency = t(1)/t(n), ideal 1.0; collective_efficiency "
                   "= t(nosync,n)/t(mode,n) isolates the framework's "
