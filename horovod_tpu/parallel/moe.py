@@ -20,6 +20,7 @@ weighting, the standard trick).
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
@@ -79,7 +80,6 @@ def moe_alltoall(x, router_logits, expert_fn: Callable, axis, *,
             f"router_logits shape {router_logits.shape} != "
             f"({tokens}, axis size {n_expert})")
     if capacity is None:
-        import math
         capacity = max(math.ceil(capacity_factor * k * tokens / n_expert),
                        4)
 

@@ -33,31 +33,31 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-MODEL_DESCRIPTIONS = {
-    "resnet": "ResNet18/32x32",
-    "vgg": "VGG16(classifier_width=256)/32x32",
-    "inception": "InceptionV3/75x75",
-}
-
-
 def _make_model(name: str):
     """The reference's three published scaling models, in small-input
     form (docs/benchmarks.rst:13-14 runs ResNet-101/Inception-V3/VGG-16;
     the virtual-CPU harness uses the light family members so the signal
-    is collective overhead, not CPU conv time)."""
+    is collective overhead, not CPU conv time). Returns
+    (model, input_side, description) — the description is derived here so
+    the recorded artifact metadata cannot drift from what ran."""
     import jax.numpy as jnp
 
     from horovod_tpu import models as M
 
     if name == "resnet":
-        return M.ResNet18(num_classes=10, dtype=jnp.float32,
-                          axis_name=None), 32
+        return (M.ResNet18(num_classes=10, dtype=jnp.float32,
+                           axis_name=None), 32, "ResNet18/32x32")
     if name == "vgg":
-        return M.VGG16(num_classes=10, dtype=jnp.float32,
-                       classifier_width=256), 32
+        width = 256
+        return (M.VGG16(num_classes=10, dtype=jnp.float32,
+                        classifier_width=width), 32,
+                f"VGG16(classifier_width={width})/32x32")
     if name == "inception":
-        return M.InceptionV3(num_classes=10, dtype=jnp.float32), 75
+        return M.InceptionV3(num_classes=10, dtype=jnp.float32), 75, \
+            "InceptionV3/75x75"
     raise ValueError(f"unknown model {name!r}")
+
+
 
 
 def child_main(n: int, mode: str, total_batch: int, iters: int,
@@ -75,7 +75,7 @@ def child_main(n: int, mode: str, total_batch: int, iters: int,
     devs = jax.devices()[:n]
     # local (non-sync) batch norm, matching the reference benchmark's
     # semantics — gradient allreduce is the only cross-device traffic
-    model, side = _make_model(model_name)
+    model, side, _desc = _make_model(model_name)
     rng = jax.random.PRNGKey(0)
     images = np.random.default_rng(0).standard_normal(
         (total_batch, side, side, 3), dtype=np.float32)
@@ -228,7 +228,7 @@ def main():
     out = args.out or os.path.join(HERE, f"SCALING_{args.model}_r4.json")
     payload = {
         "harness": "fixed-total-work strong scaling on virtual CPU devices",
-        "model": MODEL_DESCRIPTIONS[args.model],
+        "model": _make_model(args.model)[2],
         "total_batch": args.total_batch,
         "metric": "efficiency = t(1)/t(n), ideal 1.0; collective_efficiency "
                   "= t(nosync,n)/t(mode,n) isolates the framework's "

@@ -1,0 +1,42 @@
+"""Real-pyspark integration tests, skipped when pyspark is not installed
+(the reference runs against a local Spark,
+``/root/reference/test/integration/test_spark.py``). The stub tests in
+test_spark.py cover the contract; these catch barrier scheduling and
+executor-process behavior stubs cannot."""
+
+import os
+
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+
+import horovod_tpu.spark as hvd_spark
+
+
+@pytest.fixture(scope="module")
+def spark_session():
+    from pyspark.sql import SparkSession
+    spark = (SparkSession.builder.master("local[2]")
+             .appName("horovod_tpu-spark-test")
+             .config("spark.ui.enabled", "false")
+             .getOrCreate())
+    yield spark
+    spark.stop()
+
+
+def _worker_env():
+    return {k: v for k, v in os.environ.items() if k.startswith("HVD_")}
+
+
+def test_real_spark_run_rank_ordered(spark_session):
+    results = hvd_spark.run(lambda x: x * 2, args=(21,), num_proc=2)
+    assert results == [42, 42]
+
+
+def test_real_spark_run_seeds_env(spark_session):
+    envs = hvd_spark.run(_worker_env, num_proc=2)
+    ranks = sorted(int(e["HVD_RANK"]) for e in envs)
+    assert ranks == [0, 1]
+    for e in envs:
+        assert e["HVD_SIZE"] == "2"
+        assert e["HVD_KV_ADDR"] and e["HVD_SECRET_KEY"]
