@@ -38,6 +38,16 @@ class TransformerConfig:
     # shard_map with seq_axis bound and the sequence dimension sharded.
     attn_mode: str = "full"
     seq_axis: str = "sp"
+    # expert parallelism: moe_experts > 0 replaces the dense MLP with an
+    # expert-parallel MoE FFN (horovod_tpu.parallel.moe_alltoall) — one
+    # expert per chip of moe_axis, which must be bound (shard_map) with
+    # size == moe_experts at run time. The Switch load-balance loss is
+    # sown under ("intermediates", "moe_aux"); collect it with
+    # apply(..., mutable=["intermediates"]) and add it to the objective.
+    moe_experts: int = 0
+    moe_axis: str = "ep"
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
 
 
 class Attention(nn.Module):
@@ -88,6 +98,62 @@ class MLP(nn.Module):
                         use_bias=False, name="wo")(h)
 
 
+class MoeMLP(nn.Module):
+    """Expert-parallel MoE FFN: one expert per chip of ``cfg.moe_axis``,
+    routed through :func:`horovod_tpu.parallel.moe_alltoall`.
+
+    Expert weights are stored REPLICATED with a leading (n_experts, ...)
+    dim (flax's param shape check ties the stored leaf to its declared
+    shape, so a per-chip-sharded leaf cannot flow through ``self.param``).
+    Under a plain ``pmean`` gradient sync each chip produces nonzero
+    grads only for its own expert's slice, so expert gradients arrive
+    scaled by 1/axis_size relative to dense params: sync the
+    ``moe_mlp/w_in``/``w_out`` leaves with SUM or scale their learning
+    rate by the axis size. For the memory-scaling expert-parallel layout
+    (each chip storing only its expert), call
+    :func:`~horovod_tpu.parallel.moe_alltoall` directly with your own
+    parameter pytree, as ``examples/moe.py`` does — plain pytrees shard
+    freely where flax module params cannot.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        n_e, d = cfg.moe_experts, cfg.d_model
+        router = nn.Dense(n_e, name="router", dtype=jnp.float32,
+                          param_dtype=jnp.float32, use_bias=False)
+        init = nn.initializers.lecun_normal()
+        w_in = self.param("w_in", init, (n_e, d, cfg.d_ff), jnp.float32)
+        w_out = self.param("w_out", init, (n_e, cfg.d_ff, d), jnp.float32)
+        b, s, _ = x.shape
+        flat = x.reshape(b * s, d).astype(cfg.dtype)
+        logits = router(flat)
+        if self.is_initializing():
+            # no mesh axis bound at init: a dense pass through expert 0
+            # creates the params; routing never runs here
+            h = nn.gelu(flat @ w_in[0].astype(cfg.dtype))
+            return (h @ w_out[0].astype(cfg.dtype)).reshape(b, s, d)
+
+        from ..parallel import moe_alltoall
+
+        idx = jax.lax.axis_index(cfg.moe_axis)
+
+        def expert_fn(t):
+            # replicated leaves: select this chip's expert
+            wi = jax.lax.dynamic_index_in_dim(w_in, idx, 0, keepdims=False)
+            wo = jax.lax.dynamic_index_in_dim(w_out, idx, 0, keepdims=False)
+            h = nn.gelu(t @ wi.astype(t.dtype))
+            return h @ wo.astype(t.dtype)
+
+        y, aux = moe_alltoall(flat, logits, expert_fn, cfg.moe_axis,
+                              k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor)
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(b, s, d).astype(cfg.dtype)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
 
@@ -96,6 +162,8 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=self.cfg.dtype, param_dtype=jnp.float32)(x)
         x = x + Attention(self.cfg, name="attn")(y)
         y = nn.LayerNorm(dtype=self.cfg.dtype, param_dtype=jnp.float32)(x)
+        if self.cfg.moe_experts > 0:
+            return x + MoeMLP(self.cfg, name="moe_mlp")(y)
         return x + MLP(self.cfg, name="mlp")(y)
 
 

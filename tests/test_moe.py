@@ -16,8 +16,8 @@ TOKENS, D = 12, 6
 
 
 def _run(fn, *arrays, out_spec=None):
-    out_spec = out_spec if out_spec is not None else P(hvd.axis_name())
     """shard_map a function over the hvd axis with per-chip shards."""
+    out_spec = out_spec if out_spec is not None else P(hvd.axis_name())
     mesh, axis = hvd.mesh(), hvd.axis_name()
     sharding = NamedSharding(mesh, P(axis))
     f = jax.jit(jax.shard_map(
@@ -115,3 +115,58 @@ def test_load_balance_loss_uniform_is_one(hvd):
     eidx, _ = route_top_k(logits, 1)
     # uniform probs and (any) assignment: n * sum(frac_e * 1/n) = 1
     assert np.isclose(float(load_balance_loss(logits, eidx)), 1.0)
+
+
+def test_moe_transformer_trains(hvd):
+    """TransformerLM(moe_experts=n) inside shard_map over the mesh: the
+    MoE FFN routes tokens across chips and the LM still trains (loss
+    decreases with the aux loss collected from intermediates)."""
+    import optax
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    n = hvd.size()
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                            d_model=16, d_ff=32, max_seq_len=8,
+                            dtype=jnp.float32, moe_experts=n, moe_axis=axis)
+    model = TransformerLM(cfg)
+    tokens = np.random.default_rng(0).integers(0, 32, (2 * n, 8))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))["params"]
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+
+    def step(p, o, t):
+        def loss_fn(p):
+            logits, inter = model.apply(
+                {"params": p}, t, mutable=["intermediates"])
+            tgt = jnp.roll(t, -1, axis=1)
+            ce = -jnp.mean(jnp.take_along_axis(
+                jax.nn.log_softmax(logits), tgt[..., None], -1))
+            aux = sum(jnp.sum(a) for a in
+                      jax.tree_util.tree_leaves(inter["intermediates"]))
+            return ce + 0.01 * aux
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        g = jax.tree.map(lambda x: lax.pmean(x, axis), g)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, lax.pmean(loss, axis)
+
+    sharded = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P(axis)),
+        out_specs=(P(), P(), P()), check_vma=False))
+    t = jax.device_put(tokens, NamedSharding(mesh, P(axis)))
+    w_in_before = np.asarray(
+        params["block_0"]["moe_mlp"]["w_in"]).copy()
+    first = last = None
+    for _ in range(15):
+        params, opt, loss = sharded(params, opt, t)
+        jax.block_until_ready(loss)
+        last = float(jnp.ravel(loss)[0])
+        if first is None:
+            first = last
+    assert last < first, (first, last)
+    # the expert weights themselves must have received gradient — a loss
+    # decrease alone could come from the router/dense params while expert
+    # grads were zeroed or mis-routed (code-review r4)
+    w_in_after = np.asarray(params["block_0"]["moe_mlp"]["w_in"])
+    per_expert_delta = np.abs(w_in_after - w_in_before).reshape(n, -1).sum(1)
+    assert (per_expert_delta > 0).all(), per_expert_delta
