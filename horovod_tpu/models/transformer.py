@@ -105,15 +105,15 @@ class MoeMLP(nn.Module):
     Expert weights are stored REPLICATED with a leading (n_experts, ...)
     dim (flax's param shape check ties the stored leaf to its declared
     shape, so a per-chip-sharded leaf cannot flow through ``self.param``).
-    Under a plain ``pmean`` gradient sync each chip produces nonzero
-    grads only for its own expert's slice, so expert gradients arrive
-    scaled by 1/axis_size relative to dense params: sync the
-    ``moe_mlp/w_in``/``w_out`` leaves with SUM or scale their learning
-    rate by the axis size. For the memory-scaling expert-parallel layout
-    (each chip storing only its expert), call
-    :func:`~horovod_tpu.parallel.moe_alltoall` directly with your own
-    parameter pytree, as ``examples/moe.py`` does — plain pytrees shard
-    freely where flax module params cannot.
+    Each chip produces nonzero grads only for its own expert's slice, so
+    the module pre-scales the selected expert weights' gradient by
+    axis_size (a forward-identical ``w·n − stop_gradient(w)·(n−1)``):
+    the framework's standard AVERAGE gradient sync then yields exactly
+    the per-expert gradient, with no special-casing of expert leaves.
+    For the memory-scaling expert-parallel layout (each chip storing only
+    its expert), call :func:`~horovod_tpu.parallel.moe_alltoall` directly
+    with your own parameter pytree, as ``examples/moe.py`` does — plain
+    pytrees shard freely where flax module params cannot.
     """
 
     cfg: TransformerConfig
@@ -140,12 +140,19 @@ class MoeMLP(nn.Module):
 
         idx = jax.lax.axis_index(cfg.moe_axis)
 
+        def grad_boost(w):
+            # forward-identical (up to one rounding step), backward xn:
+            # each chip contributes grads for ONE expert, so the AVERAGE
+            # sync's 1/n is pre-cancelled here and expert leaves need no
+            # special treatment in the optimizer
+            return w * n_e - jax.lax.stop_gradient(w) * (n_e - 1)
+
         def expert_fn(t):
             # replicated leaves: select this chip's expert
             wi = jax.lax.dynamic_index_in_dim(w_in, idx, 0, keepdims=False)
             wo = jax.lax.dynamic_index_in_dim(w_out, idx, 0, keepdims=False)
-            h = nn.gelu(t @ wi.astype(t.dtype))
-            return h @ wo.astype(t.dtype)
+            h = nn.gelu(t @ grad_boost(wi).astype(t.dtype))
+            return h @ grad_boost(wo).astype(t.dtype)
 
         y, aux = moe_alltoall(flat, logits, expert_fn, cfg.moe_axis,
                               k=cfg.moe_top_k,

@@ -11,7 +11,10 @@ needs to stay busy on long sequences).
 
 The kernel carries the running (m, l, acc) statistics **between**
 invocations, so the ring loop can rotate K/V with ``ppermute`` and call it
-once per step. Backward runs the jnp formulation under ``jax.vjp``
+once per step. Inside one invocation the grid tiles BOTH dimensions —
+(batch·head, q-tile, kv-tile), the kv sweep innermost so the VMEM scratch
+carries per q-tile — bounding VMEM at O(q_tile·d) instead of O(sq·d) and
+extending the kernel to sequence blocks far beyond one tile. Backward runs the jnp formulation under ``jax.vjp``
 (flash-style recompute: nothing but the carries is saved), wired up with
 ``jax.custom_vjp`` so training steps differentiate straight through the
 kernel. CPU tests run the same kernel with ``interpret=True``.
@@ -69,35 +72,37 @@ def _attend_jnp(q, k, v, qpos0, kpos0, causal, m, l, acc):
 
 
 DEFAULT_KV_TILE = 512
+DEFAULT_Q_TILE = 1024  # bounds VMEM: scratch is O(q_tile*d), not O(sq*d)
 
 
 def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
                   acc_ref, mo_ref, lo_ref, acco_ref, m_s, l_s, acc_s, *,
-                  causal, kv_tile):
-    j = pl.program_id(1)
-    n_kv = pl.num_programs(1)
+                  causal, q_tile, kv_tile):
+    qi = pl.program_id(1)  # q-tile index (kv sweep is the innermost dim,
+    j = pl.program_id(2)   # so scratch carries are per-(bh, q-tile))
+    n_kv = pl.num_programs(2)
 
     @pl.when(j == 0)
-    def _init():  # load this program's incoming carries into scratch
+    def _init():  # load this q-tile's incoming carries into scratch
         m_s[:] = m_ref[0]
         l_s[:] = l_ref[0]
         acc_s[:] = acc_ref[0]
 
-    q = q_ref[0]          # (sq, d)
+    q = q_ref[0]          # (q_tile, d)
     k = k_ref[0]          # (kv_tile, d)
     v = v_ref[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)  # (sq, kv_tile) on the MXU
+        preferred_element_type=jnp.float32)  # (q_tile, kv_tile), MXU
     if causal:
-        sq, sk = s.shape
+        tq, sk = s.shape
         # mosaic iota must be integer-typed; int32 offsets are exact
-        qpos = (qpos_ref[0]
-                + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0))
+        qpos = (qpos_ref[0] + qi * q_tile
+                + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 0))
         kpos = (kpos_ref[0] + j * kv_tile
-                + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
+                + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 1))
         s = jnp.where(qpos >= kpos, s, NEG_INF)
-    m_prev = m_s[:]       # (sq, 1) f32
+    m_prev = m_s[:]       # (q_tile, 1) f32
     l_prev = l_s[:]
     acc_prev = acc_s[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -121,34 +126,48 @@ def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
         acco_ref[0] = acc_s[:]
 
 
+def _pick_tile(size: int, default: int) -> int:
+    """Largest divisor of ``size`` that is <= ``default`` — the VMEM bound
+    must hold for ragged sizes too (a whole-dimension fallback would
+    silently undo the tiling for e.g. prime-ish long sequences)."""
+    if size <= default:
+        return size
+    if size % default == 0:
+        return default
+    for t in range(default, 0, -1):
+        if size % t == 0:
+            return t
+    return size  # unreachable (t=1 always divides)
+
+
 def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sq, d = q.shape
     sk = k.shape[1]
-    kv_tile = min(sk, DEFAULT_KV_TILE)
-    if sk % kv_tile:
-        kv_tile = sk  # ragged tail: fall back to one tile
+    kv_tile = _pick_tile(sk, DEFAULT_KV_TILE)
+    q_tile = _pick_tile(sq, DEFAULT_Q_TILE)
     n_kv = sk // kv_tile
+    n_q = sq // q_tile
     kernel = functools.partial(_flash_kernel, causal=causal,
-                               kv_tile=kv_tile)
+                               q_tile=q_tile, kv_tile=kv_tile)
     return pl.pallas_call(
         kernel,
-        grid=(bh, n_kv),
+        grid=(bh, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1,), lambda i, j: (0,)),       # qpos0
-            pl.BlockSpec((1,), lambda i, j: (0,)),       # kpos0
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, kv_tile, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, kv_tile, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1,), lambda i, qi, j: (0,)),       # qpos0
+            pl.BlockSpec((1,), lambda i, qi, j: (0,)),       # kpos0
+            pl.BlockSpec((1, q_tile, d), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, kv_tile, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, kv_tile, d), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, q_tile, 1), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, q_tile, 1), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, q_tile, d), lambda i, qi, j: (i, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, q_tile, 1), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, q_tile, 1), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, q_tile, d), lambda i, qi, j: (i, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
@@ -156,9 +175,9 @@ def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
             jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((sq, 1), jnp.float32),
-            pltpu.VMEM((sq, 1), jnp.float32),
-            pltpu.VMEM((sq, d), jnp.float32),
+            pltpu.VMEM((q_tile, 1), jnp.float32),
+            pltpu.VMEM((q_tile, 1), jnp.float32),
+            pltpu.VMEM((q_tile, d), jnp.float32),
         ],
         interpret=interpret,
     )(jnp.asarray([qpos0], jnp.int32).reshape(1),

@@ -223,13 +223,19 @@ class KVServer:
 
 
 class KVClient:
-    """HTTP client for :class:`KVServer` (reference ``http_client.py``)."""
+    """HTTP client for :class:`KVServer` (reference ``http_client.py``).
+
+    The default per-request timeout honors ``HVD_GLOO_TIMEOUT_SECONDS``
+    (the reference's transport-op timeout knob, ``common.h:120``): raise
+    it on congested fabrics where a negotiation round can exceed 30 s."""
 
     def __init__(self, addr: str, port: int, secret: str | None = None,
-                 timeout: float = 30.0):
+                 timeout: float | None = None):
+        from ..utils import envs
         self._base = f"http://{addr}:{port}"
         self._secret = secret
-        self._timeout = timeout
+        self._timeout = timeout if timeout is not None else \
+            envs.get_float(envs.GLOO_TIMEOUT_SECONDS, 30.0)
 
     def _request(self, method: str, path: str, payload: bytes = b""):
         req = urllib.request.Request(

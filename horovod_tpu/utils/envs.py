@@ -33,10 +33,12 @@ STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"
 HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
 HIERARCHICAL_ICI_SIZE = "HIERARCHICAL_ICI_SIZE"  # chips per ICI island; default local_size
-BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
+# (the reference's HOROVOD_BATCH_D2D_MEMCOPIES has no knob here by
+# design: XLA fuses small copies into the compiled program, so there is
+# nothing runtime-batchable to toggle)
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
-GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"
+GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)

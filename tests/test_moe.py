@@ -170,3 +170,19 @@ def test_moe_transformer_trains(hvd):
     w_in_after = np.asarray(params["block_0"]["moe_mlp"]["w_in"])
     per_expert_delta = np.abs(w_in_after - w_in_before).reshape(n, -1).sum(1)
     assert (per_expert_delta > 0).all(), per_expert_delta
+
+
+def test_moe_mlp_grad_boost_cancels_average_sync(hvd):
+    """The expert-weight gradient pre-scaling must be forward-identical
+    and backward x n_experts, so AVERAGE sync returns the true per-expert
+    gradient (code-review r4: 1/n silent shrink under pmean)."""
+    n = 8
+    w = jnp.asarray([[1.234, -0.5], [0.25, 3.0]])
+
+    def boost(w):
+        return w * n - jax.lax.stop_gradient(w) * (n - 1)
+
+    np.testing.assert_allclose(np.asarray(boost(w)), np.asarray(w),
+                               rtol=1e-6)
+    g = jax.grad(lambda w: jnp.sum(boost(w) * 2.0))(w)
+    np.testing.assert_allclose(np.asarray(g), 2.0 * n, rtol=1e-6)

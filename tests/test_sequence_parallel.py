@@ -330,6 +330,32 @@ def test_ring_attention_pallas_gradients():
         assert np.allclose(gp, gj, rtol=1e-4, atol=1e-5), np.abs(gp - gj).max()
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_kernel_q_tiling(monkeypatch, causal):
+    """Multiple q tiles per invocation (grid dim 1) must match the
+    single-tile jnp formulation exactly — the per-q-tile scratch carry
+    init/flush is the subtle part."""
+    from horovod_tpu.ops import flash
+
+    monkeypatch.setattr(flash, "DEFAULT_Q_TILE", 4)
+    monkeypatch.setattr(flash, "DEFAULT_KV_TILE", 8)
+    bh, sq, d = 3, 16, 8  # 4 q-tiles x 2 kv-tiles
+    rng = np.random.default_rng(11)
+    q, k, v = [jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+               for _ in range(3)]
+    m = jnp.full((bh, sq, 1), flash.NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, sq, 1), jnp.float32)
+    acc = jnp.zeros((bh, sq, d), jnp.float32)
+    zero = jnp.asarray(0, jnp.int32)
+    mk, lk, ak = flash.block_attend(q, k, v, zero, zero, causal, True,
+                                    m, l, acc)
+    mj, lj, aj = flash._attend_jnp(q, k, v, zero, zero, causal, m, l, acc)
+    for got, want in ((mk, mj), (lk, lj), (ak, aj)):
+        assert np.allclose(np.asarray(got), np.asarray(want),
+                           rtol=1e-5, atol=1e-6), \
+            np.abs(np.asarray(got) - np.asarray(want)).max()
+
+
 def test_flash_kernel_compiled_on_tpu():
     """Compiled (non-interpret) Mosaic kernel vs jnp formulation — runs
     only when the suite executes on a real TPU (verified manually on v5e;
@@ -366,3 +392,14 @@ def test_ulysses_blockwise_local_attention():
     expect = reference_attention(np.asarray(q), np.asarray(k), np.asarray(v),
                                  True)
     assert np.allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_pick_tile_bounds_ragged_sizes():
+    """Ragged dims must still be tiled (largest divisor <= default), not
+    fall back to one whole-dimension tile that unbounds VMEM."""
+    from horovod_tpu.ops.flash import _pick_tile
+
+    assert _pick_tile(16, 1024) == 16       # small: one tile
+    assert _pick_tile(4096, 1024) == 1024   # exact multiple
+    assert _pick_tile(24, 10) == 8          # ragged: largest divisor <= 10
+    assert _pick_tile(7919, 1024) == 1      # prime: still bounded
