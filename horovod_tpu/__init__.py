@@ -77,6 +77,7 @@ from .ops import (
     broadcast_async,
     broadcast_object,
     grouped_allreduce,
+    grouped_allreduce_async,
     grouped_broadcast,
     hierarchical_allgather,
     hierarchical_allreduce,
@@ -88,6 +89,7 @@ from .ops import (
     rows_from_dense,
     rows_to_dense,
     sparse_allreduce,
+    sparse_allreduce_async,
     sparse_allreduce_to_dense,
     synchronize,
 )
@@ -145,10 +147,10 @@ __all__ = [
     "Product", "ReduceOp", "Sum", "adasum_allreduce", "allgather",
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
-    "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce", "grouped_broadcast",
+    "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
-    "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce",
+    "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",
     "sparse_allreduce_to_dense",
     "ProcessSet", "add_process_set", "global_process_set", "remove_process_set",
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",

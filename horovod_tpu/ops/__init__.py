@@ -15,6 +15,7 @@ from .collectives import (
     broadcast_async,
     broadcast_object,
     grouped_allreduce,
+    grouped_allreduce_async,
     grouped_broadcast,
     join,
     per_rank,
@@ -33,6 +34,7 @@ from .sparse import (
     rows_from_dense,
     rows_to_dense,
     sparse_allreduce,
+    sparse_allreduce_async,
     sparse_allreduce_to_dense,
 )
 
@@ -41,9 +43,9 @@ __all__ = [
     "Compression", "Handle", "PerRank", "allgather", "allgather_async",
     "allgather_object", "allreduce", "allreduce_async", "alltoall",
     "alltoall_async", "barrier", "broadcast", "broadcast_async",
-    "broadcast_object", "grouped_allreduce", "grouped_broadcast", "join", "per_rank", "poll",
+    "broadcast_object", "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast", "join", "per_rank", "poll",
     "reducescatter", "synchronize", "adasum_allreduce",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
-    "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce",
+    "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",
     "sparse_allreduce_to_dense",
 ]

@@ -1345,6 +1345,12 @@ def allreduce_async(tensor, **kw) -> Handle:
     return Handle(allreduce(tensor, **kw))
 
 
+def grouped_allreduce_async(tensors, **kw) -> Handle:
+    """Handle over a fused grouped allreduce (reference
+    ``grouped_allreduce_async``, ``torch/mpi_ops.py:375``)."""
+    return Handle(grouped_allreduce(tensors, **kw))
+
+
 def allgather_async(tensor, **kw) -> Handle:
     return Handle(allgather(tensor, **kw))
 

@@ -144,3 +144,11 @@ def sparse_allreduce_to_dense(grad, max_rows: int, *,
     reduced = sparse_allreduce(rows, op=op, process_set=process_set,
                                name=name, axis_name=axis_name)
     return rows_to_dense(reduced).astype(grad.dtype)
+
+
+def sparse_allreduce_async(rows, **kw):
+    """Completion handle over :func:`sparse_allreduce` (reference
+    ``sparse_allreduce_async``, ``torch/mpi_ops.py:556-579`` — allgather
+    of indices+values wrapped in a synthesized handle)."""
+    from .collectives import Handle
+    return Handle(sparse_allreduce(rows, **kw))

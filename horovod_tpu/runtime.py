@@ -139,24 +139,29 @@ def init(
 
 
 def _distributed_client_active() -> bool:
-    try:
-        from jax._src import distributed as _dist
-        return _dist.global_state.client is not None
-    except Exception:  # pragma: no cover - private API moved
-        return False
+    return _distributed_kv_client() is not None
 
 
 def _maybe_distributed_init() -> None:
     """Bootstrap ``jax.distributed`` from launcher-seeded env, the analog of
     the reference rendezvous (``GlooContext::Initialize`` reading
-    ``HOROVOD_GLOO_RENDEZVOUS_ADDR``, ``gloo_context.h:29-42``).
+    ``HOROVOD_GLOO_RENDEZVOUS_ADDR``, ``gloo_context.h:29-42``). Jobs
+    launched by ``srun``/``mpirun`` instead of ``hvdrun`` (the reference's
+    primary launch modes, ``mpi_run.py``/``lsf.py``) are auto-detected:
+    jax's own cluster detection joins the world, and the negotiation KV is
+    bootstrapped over jax's distributed key-value store
+    (:func:`_maybe_bootstrap_kv`).
 
     NOTE: must run before anything touches the XLA backend — we avoid any
     jax query here and check env + the distributed client state only.
     """
     addr = envs.get(envs.COORDINATOR_ADDR)
     num_proc = envs.get_int(envs.NUM_PROCESSES, 1)
-    if addr is None or num_proc <= 1 or _distributed_client_active():
+    if _distributed_client_active():
+        _maybe_bootstrap_kv()
+        return
+    if addr is None or num_proc <= 1:
+        _maybe_cluster_autodetect()
         return
     port = envs.get(envs.COORDINATOR_PORT, "9778")
     proc_id = envs.get_int(envs.PROCESS_ID, 0)
@@ -175,6 +180,7 @@ def _maybe_distributed_init() -> None:
         )
         hvd_logging.info("jax.distributed initialized: process %d/%d via %s:%s",
                          proc_id, num_proc, addr, port)
+        _maybe_bootstrap_kv()
     except RuntimeError as e:
         # Either the backend was already initialized by earlier user code
         # (jax.distributed must come first) or the coordinator is
@@ -187,16 +193,127 @@ def _maybe_distributed_init() -> None:
             "yourself.", e, len(jax.local_devices()))
 
 
+# (world-size var, per-process rank var): the rank var is only set inside
+# an actual srun/mpirun task — an `#SBATCH --ntasks=8` script running
+# plain `python` exports SLURM_NTASKS but no SLURM_PROCID, and must NOT
+# trigger a blocking multi-process join.
+_CLUSTER_ENV_PAIRS = (("SLURM_NTASKS", "SLURM_PROCID"),
+                      ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
+                      ("PMI_SIZE", "PMI_RANK"))
+
+
+def _cluster_world_hint() -> int:
+    """World size advertised by a cluster scheduler's env (srun / mpirun /
+    PMI), 1 when none — or when only the batch-level var is present
+    without the per-task rank var."""
+    for world_var, rank_var in _CLUSTER_ENV_PAIRS:
+        val = os.environ.get(world_var)
+        if val and os.environ.get(rank_var) is not None:
+            try:
+                return int(val)
+            except ValueError:
+                pass
+    return 1
+
+
+def _maybe_cluster_autodetect() -> None:
+    """`srun python train.py` / `mpirun -np N python train.py` parity:
+    when a scheduler advertises a multi-process world and no launcher env
+    is present, let jax's built-in cluster detection (SLURM / Open MPI)
+    join the world, then bootstrap the negotiation KV."""
+    if _cluster_world_hint() <= 1:
+        return
+    try:
+        jax.distributed.initialize()  # jax auto-detects SLURM/OMPI
+        hvd_logging.info(
+            "jax.distributed auto-initialized from cluster env: "
+            "process %d/%d", jax.process_index(), jax.process_count())
+    except Exception as e:
+        hvd_logging.error(
+            "cluster env advertises a multi-process world but "
+            "jax.distributed auto-detection failed (%s); running "
+            "single-process. Launch with hvdrun, or pre-initialize "
+            "jax.distributed yourself.", e)
+        return
+    _maybe_bootstrap_kv()
+
+
+_bootstrap_kv_server = None  # keep-alive for the process-0 KV server
+_bootstrap_seeded_env = False  # whether WE seeded HVD_KV_* (vs a launcher)
+_KV_BOOTSTRAP_KEY = "hvd/kv_bootstrap/{}"  # per-generation: re-init safe
+
+
+def _distributed_kv_client():
+    """jax's distributed key-value client (None when unavailable)."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client
+    except Exception:  # pragma: no cover - private API moved
+        return None
+
+
+def _maybe_bootstrap_kv() -> None:
+    """Stand up the negotiation/rendezvous KV for worlds NOT launched by
+    ``hvdrun`` (srun/mpirun/user-initialized jax.distributed): process 0
+    starts a :class:`KVServer` and publishes ``addr:port:secret`` through
+    jax's distributed KV store; everyone seeds the usual ``HVD_KV_*`` env
+    so the dynamic engine and elastic plumbing work identically to a
+    launcher job. The exchange key carries the init generation, so an
+    init/shutdown/init cycle publishes fresh coordinates instead of
+    colliding with (or reusing) the previous world's."""
+    global _bootstrap_kv_server, _bootstrap_seeded_env
+    if envs.get(envs.KV_ADDR):
+        return  # launcher already provided one
+    client = _distributed_kv_client()
+    if client is None or jax.process_count() <= 1:
+        return  # nothing to negotiate in a single-process world
+    key = _KV_BOOTSTRAP_KEY.format(_generation)
+    try:
+        if jax.process_index() == 0:
+            from .runner.http_kv import KVServer, local_addresses, make_secret
+            secret = make_secret()
+            server = KVServer(secret=secret)
+            port = server.start()
+            _bootstrap_kv_server = server
+            payload = f"{local_addresses()[0]}:{port}:{secret}"
+            client.key_value_set(key, payload)
+        else:
+            payload = client.blocking_key_value_get(key, 60_000)
+        addr, port, secret = payload.split(":", 2)
+        os.environ["HVD_KV_ADDR"] = addr
+        os.environ["HVD_KV_PORT"] = port
+        os.environ["HVD_SECRET_KEY"] = secret
+        _bootstrap_seeded_env = True
+        hvd_logging.info("negotiation KV bootstrapped at %s:%s", addr, port)
+    except Exception as e:
+        hvd_logging.warning(
+            "could not bootstrap the negotiation KV over jax's distributed "
+            "store (%s); multi-process eager collectives will run without "
+            "negotiation (mismatches hang instead of erroring)", e)
+
+
 def shutdown() -> None:
     """Tear down the runtime (reference ``horovod_shutdown``,
     ``operations.cc:926-942``). Also stops the negotiation service — it is
     bound to this world's size/rank/KV prefix and must be rebuilt by the
     next init()."""
-    global _state
+    global _state, _bootstrap_kv_server, _bootstrap_seeded_env
     from . import autotune as _autotune
     from . import engine_service as _engine_service
     _engine_service.reset_service()
     _autotune.reset()
+    if _bootstrap_kv_server is not None:
+        try:
+            _bootstrap_kv_server.stop()
+        except Exception:
+            pass
+        _bootstrap_kv_server = None
+    if _bootstrap_seeded_env:
+        # the seeded coordinates point at the server just stopped; a later
+        # init() must bootstrap afresh, not trust stale env
+        for var in ("HVD_KV_ADDR", "HVD_KV_PORT", "HVD_SECRET_KEY"):
+            os.environ.pop(var, None)
+        _bootstrap_seeded_env = False
     with _lock:
         _state = None
 

@@ -288,3 +288,24 @@ def test_allreduce_average_over_subaxis(hvd):
 def test_gspmd_passthrough_min_raises(hvd):
     with pytest.raises(RuntimeError):
         jax.jit(lambda v: hvd.allreduce(v, op=hvd.Min))(jnp.ones(2))
+
+
+def test_grouped_allreduce_async(hvd):
+    t1 = _rank_values((4,))
+    t2 = _rank_values((2,), mult=10.0)
+    h = hvd.grouped_allreduce_async(
+        [hvd.per_rank(t1), hvd.per_rank(t2)], op=hvd.Sum)
+    outs = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((4,), 36.0))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full((2,), 360.0))
+
+
+def test_sparse_allreduce_async(hvd):
+    from horovod_tpu.ops.sparse import SparseRows, sparse_allreduce_async
+    rows = SparseRows(indices=jnp.asarray([0, 2]),
+                      values=jnp.ones((2, 3)), num_rows=4)
+    h = sparse_allreduce_async(rows, op=hvd.Sum)
+    out = hvd.synchronize(h)
+    dense = np.asarray(hvd.rows_to_dense(out))
+    np.testing.assert_allclose(dense[0], N * 1.0)
+    np.testing.assert_allclose(dense[1], 0.0)

@@ -305,3 +305,59 @@ class TestJoin:
         """, np=2)
         assert proc.returncode == 0, proc.stdout
         assert proc.stdout.count("WORKER_OK") == 2, proc.stdout
+
+
+class TestKvBootstrap:
+    """Worlds NOT launched by hvdrun (srun/mpirun/user jax.distributed)
+    bootstrap the negotiation KV over jax's distributed store
+    (runtime._maybe_bootstrap_kv): process 0 serves, everyone seeds
+    HVD_KV_* — the dynamic engine then works exactly as under hvdrun."""
+
+    def test_engine_works_without_launcher_kv(self, tmp_path):
+        # strip the launcher KV contract BEFORE importing horovod_tpu so
+        # init() sees a coordinator (simulating a pre-initialized world)
+        # but no KV — the bootstrap path must provide one
+        body = """
+        import numpy as np
+        from horovod_tpu import engine_service
+        from horovod_tpu.dynamic import HorovodCollectiveError
+        assert engine_service.get_service() is not None, \\
+            "bootstrap KV did not reach the engine"
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Sum, name="boot")
+        assert np.allclose(np.asarray(out), 2.0), out
+        # negotiation really runs: a metadata mismatch must ERROR, not hang
+        shape = 3 if rank == 0 else 5
+        try:
+            hvd.allreduce(jnp.ones(shape), op=hvd.Sum, name="clash")
+            print("NO_ERROR", rank, flush=True)
+        except HorovodCollectiveError:
+            print("GOT_MISMATCH", rank, flush=True)
+        print("WORKER_OK", rank, flush=True)
+        """
+        prelude = textwrap.dedent("""\
+            import os
+            os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+            rank = int(os.environ["HVD_RANK"])
+            for k in ("HVD_KV_ADDR", "HVD_KV_PORT", "HVD_SECRET_KEY"):
+                os.environ.pop(k, None)
+            import jax
+            try: jax.config.update("jax_platforms", "cpu")
+            except Exception: pass
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+            hvd.init()
+            """)
+        worker = tmp_path / "worker.py"
+        worker.write_text(prelude + textwrap.dedent(body))
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+             "--", sys.executable, str(worker)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout
+        assert proc.stdout.count("WORKER_OK") == 2, proc.stdout
+        assert proc.stdout.count("GOT_MISMATCH") == 2, proc.stdout
+        assert "NO_ERROR" not in proc.stdout

@@ -59,3 +59,20 @@ def test_capability_queries():
     assert not hvd.ccl_built() and not hvd.cuda_built()
     assert not hvd.rocm_built()
     assert hvd.tpu_built() in (True, False)  # backend-dependent
+
+
+def test_cluster_world_hint_requires_per_task_rank_var(monkeypatch):
+    """`#SBATCH --ntasks=8` + plain `python` exports SLURM_NTASKS but no
+    SLURM_PROCID — init must NOT attempt a blocking multi-process join
+    (code-review r4)."""
+    from horovod_tpu import runtime as rt
+    for wv, rv in rt._CLUSTER_ENV_PAIRS:
+        monkeypatch.delenv(wv, raising=False)
+        monkeypatch.delenv(rv, raising=False)
+    assert rt._cluster_world_hint() == 1
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    assert rt._cluster_world_hint() == 1  # no SLURM_PROCID: batch script
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    assert rt._cluster_world_hint() == 8  # inside an srun task
+    monkeypatch.setenv("SLURM_NTASKS", "garbage")
+    assert rt._cluster_world_hint() == 1
