@@ -69,8 +69,10 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--elastic", action="store_true")
     parser.add_argument("--workers", type=int, default=2)
-    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--smoke", action="store_true",
+                        help="fewer training steps")
     args = parser.parse_args()
+    steps = 3 if args.smoke else 10
 
     try:
         import ray  # noqa: F401
@@ -85,7 +87,7 @@ def main():
         try:
             # elastic worker fns wrap their loop in hvd.elastic.run; this
             # demo uses the static-shaped fn for brevity
-            results = ex.run(train_fn)
+            results = ex.run(train_fn, args=(steps,))
         finally:
             ex.shutdown()
     else:
@@ -93,7 +95,7 @@ def main():
         ex = RayExecutor(num_workers=args.workers)
         ex.start()
         try:
-            results = ex.run(train_fn)
+            results = ex.run(train_fn, args=(steps,))
         finally:
             ex.shutdown()
     print(f"final losses per rank: {results}")

@@ -14,10 +14,15 @@ invocations, so the ring loop can rotate K/V with ``ppermute`` and call it
 once per step. Inside one invocation the grid tiles BOTH dimensions —
 (batch·head, q-tile, kv-tile), the kv sweep innermost so the VMEM scratch
 carries per q-tile — bounding VMEM at O(q_tile·d) instead of O(sq·d) and
-extending the kernel to sequence blocks far beyond one tile. Backward runs the jnp formulation under ``jax.vjp``
-(flash-style recompute: nothing but the carries is saved), wired up with
-``jax.custom_vjp`` so training steps differentiate straight through the
-kernel. CPU tests run the same kernel with ``interpret=True``.
+extending the kernel to sequence blocks far beyond one tile.
+
+Two backward paths exist. The ring schedule's re-rotating VJP calls the
+dedicated Pallas backward kernels (:func:`flash_block_grads`: a dq pass
+sweeping kv tiles innermost and a dk/dv pass sweeping q tiles innermost —
+logits recomputed per tile in VMEM, never materialized in HBM).
+``block_attend``'s own ``custom_vjp`` (the Ulysses/local path) recomputes
+through the jnp formulation under ``jax.vjp`` (nothing but the carries is
+saved). CPU tests run every kernel with ``interpret=True``.
 """
 
 from __future__ import annotations
@@ -75,6 +80,19 @@ DEFAULT_KV_TILE = 512
 DEFAULT_Q_TILE = 1024  # bounds VMEM: scratch is O(q_tile*d), not O(sq*d)
 
 
+def _tile_causal_mask(s, qpos_ref, kpos_ref, qi, j, q_tile, kv_tile):
+    """Causal mask for one (q-tile, kv-tile) score block — THE masking
+    rule, shared by the forward and both backward kernels so they cannot
+    drift (the jnp twin is :func:`causal_mask_scores`). Mosaic iota must
+    be integer-typed; int32 offsets are exact past 2^24."""
+    tq, sk = s.shape
+    qpos = (qpos_ref[0] + qi * q_tile
+            + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 0))
+    kpos = (kpos_ref[0] + j * kv_tile
+            + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 1))
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
 def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
                   acc_ref, mo_ref, lo_ref, acco_ref, m_s, l_s, acc_s, *,
                   causal, q_tile, kv_tile):
@@ -95,13 +113,7 @@ def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)  # (q_tile, kv_tile), MXU
     if causal:
-        tq, sk = s.shape
-        # mosaic iota must be integer-typed; int32 offsets are exact
-        qpos = (qpos_ref[0] + qi * q_tile
-                + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 0))
-        kpos = (kpos_ref[0] + j * kv_tile
-                + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 1))
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _tile_causal_mask(s, qpos_ref, kpos_ref, qi, j, q_tile, kv_tile)
     m_prev = m_s[:]       # (q_tile, 1) f32
     l_prev = l_s[:]
     acc_prev = acc_s[:]
@@ -183,6 +195,147 @@ def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
     )(jnp.asarray([qpos0], jnp.int32).reshape(1),
       jnp.asarray([kpos0], jnp.int32).reshape(1),
       q, k, v, m, l, acc)
+
+
+# --------------------------------------------------------------------------
+# backward kernels: block gradients with the normalized-softmax identities
+# (dV += pT.dO, dS = p o (dO.VT - D), dQ += dS.K, dK += dST.Q with
+# p = exp(s - lse), D = rowsum(dO o O)) — the flash-attention backward.
+# Two passes so each accumulator lives in VMEM: dQ sweeps kv tiles
+# innermost, dK/dV sweep q tiles innermost. Logits are recomputed per tile
+# and never reach HBM (the jnp fallback materializes the block logits).
+# --------------------------------------------------------------------------
+
+
+def _bwd_scores(q, k, qpos_ref, kpos_ref, lse, qi, j, q_tile, kv_tile,
+                causal):
+    """Recompute the normalized softmax block p = exp(s - lse), masked by
+    the SAME :func:`_tile_causal_mask` the forward kernel uses."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        s = _tile_causal_mask(s, qpos_ref, kpos_ref, qi, j, q_tile, kv_tile)
+    p = jnp.exp(s - lse)
+    if causal:
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
+    return p
+
+
+def _flash_bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
+                         d_ref, do_ref, dq_ref, dq_s, *, causal, q_tile,
+                         kv_tile):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)  # kv sweep innermost: dq accumulates per q tile
+    n_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    p = _bwd_scores(q_ref[0], k_ref[0], qpos_ref, kpos_ref, lse_ref[0],
+                    qi, j, q_tile, kv_tile, causal)
+    do = do_ref[0]
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - d_ref[0])
+    dq_s[:] += jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_kv - 1)
+    def _flush():
+        dq_ref[0] = dq_s[:]
+
+
+def _flash_bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
+                          d_ref, do_ref, dk_ref, dv_ref, dk_s, dv_s, *,
+                          causal, q_tile, kv_tile):
+    j = pl.program_id(1)
+    qi = pl.program_id(2)  # q sweep innermost: dk/dv accumulate per kv tile
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    q = q_ref[0]
+    p = _bwd_scores(q, k_ref[0], qpos_ref, kpos_ref, lse_ref[0],
+                    qi, j, q_tile, kv_tile, causal)
+    do = do_ref[0]
+    dv_s[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - d_ref[0])
+    dk_s[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_s[:]
+        dv_ref[0] = dv_s[:]
+
+
+def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,
+                      interpret=False):
+    """Pallas block gradients for the ring/local flash backward:
+    ``(dq, dk, dv)`` for one K/V block against the full saved ``lse``.
+    Shapes: q/dout (bh, sq, d); k/v (bh, sk, d); lse/D (bh, sq, 1), with
+    ``D = rowsum(dout * out)``. Float32 outputs. The jnp equivalent is the
+    einsum block in :func:`horovod_tpu.parallel.sequence._ring_core_bwd`.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    q_tile = _pick_tile(sq, DEFAULT_Q_TILE)
+    kv_tile = _pick_tile(sk, DEFAULT_KV_TILE)
+    n_q, n_kv = sq // q_tile, sk // kv_tile
+    qpos0 = jnp.asarray([qpos0], jnp.int32).reshape(1)
+    kpos0 = jnp.asarray([kpos0], jnp.int32).reshape(1)
+    pos_spec = pl.BlockSpec((1,), lambda i, a, b: (0,))
+
+    def q_spec_dq(which):  # blocks indexed by the q-tile grid position
+        return pl.BlockSpec((1, q_tile, which),
+                            lambda i, qi, j: (i, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                          q_tile=q_tile, kv_tile=kv_tile),
+        grid=(bh, n_q, n_kv),
+        in_specs=[pos_spec, pos_spec,
+                  q_spec_dq(d),
+                  pl.BlockSpec((1, kv_tile, d), lambda i, qi, j: (i, j, 0)),
+                  pl.BlockSpec((1, kv_tile, d), lambda i, qi, j: (i, j, 0)),
+                  q_spec_dq(1), q_spec_dq(1), q_spec_dq(d)],
+        out_specs=q_spec_dq(d),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((q_tile, d), jnp.float32)],
+        interpret=interpret,
+    )(qpos0, kpos0, q, k, v, lse, D, dout)
+
+    kv_spec = pl.BlockSpec((1, kv_tile, d), lambda i, j, qi: (i, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                          q_tile=q_tile, kv_tile=kv_tile),
+        grid=(bh, n_kv, n_q),
+        in_specs=[pos_spec, pos_spec,
+                  pl.BlockSpec((1, q_tile, d), lambda i, j, qi: (i, qi, 0)),
+                  kv_spec, kv_spec,
+                  pl.BlockSpec((1, q_tile, 1), lambda i, j, qi: (i, qi, 0)),
+                  pl.BlockSpec((1, q_tile, 1), lambda i, j, qi: (i, qi, 0)),
+                  pl.BlockSpec((1, q_tile, d), lambda i, j, qi: (i, qi, 0))],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((kv_tile, d), jnp.float32),
+                        pltpu.VMEM((kv_tile, d), jnp.float32)],
+        interpret=interpret,
+    )(qpos0, kpos0, q, k, v, lse, D, dout)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))

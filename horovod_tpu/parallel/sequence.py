@@ -141,6 +141,13 @@ def _ring_core_bwd(axis, causal, use_pallas, interpret, res, cts):
             from ..ops import flash
 
             dq, dk_a, dv_a = carry
+            if use_pallas or interpret:
+                # pallas backward: logits recomputed per tile in VMEM,
+                # never materialized at O(sq*sk) in HBM
+                dq_blk, dk_blk, dv_blk = flash.flash_block_grads(
+                    qf, _k, _v, lse, dout, D, _qp, _kp, causal,
+                    interpret=interpret)
+                return dq + dq_blk, dk_a + dk_blk, dv_a + dv_blk
             s = jnp.einsum("bqd,bkd->bqk", qf, _k,
                            preferred_element_type=jnp.float32)
             if causal:

@@ -403,3 +403,54 @@ def test_flash_pick_tile_bounds_ragged_sizes():
     assert _pick_tile(4096, 1024) == 1024   # exact multiple
     assert _pick_tile(24, 10) == 8          # ragged: largest divisor <= 10
     assert _pick_tile(7919, 1024) == 1      # prime: still bounded
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_kernel_multi_tile(monkeypatch, causal):
+    """flash_block_grads (pallas dq + dkv kernels) must match the jnp
+    backward identities across multiple q AND kv tiles, including the
+    per-tile scratch accumulate/flush in both sweep orders."""
+    from horovod_tpu.ops import flash
+
+    monkeypatch.setattr(flash, "DEFAULT_Q_TILE", 4)
+    monkeypatch.setattr(flash, "DEFAULT_KV_TILE", 4)
+    bh, sq, sk, d = 2, 12, 8, 8  # 3 q-tiles x 2 kv-tiles
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    dout = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    qpos0 = jnp.asarray(4, jnp.int32)   # offset blocks, like a ring step
+    kpos0 = jnp.asarray(0, jnp.int32)
+
+    # forward stats via the jnp formulation
+    m = jnp.full((bh, sq, 1), flash.NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, sq, 1), jnp.float32)
+    acc = jnp.zeros((bh, sq, d), jnp.float32)
+    m1, l1, acc1 = flash._attend_jnp(q, k, v, qpos0, kpos0, causal,
+                                     m, l, acc)
+    l_safe = jnp.maximum(l1, 1e-30)
+    out = acc1 / l_safe
+    lse = m1 + jnp.log(l_safe)
+    D = jnp.sum(dout * out, axis=-1, keepdims=True)
+
+    got = flash.flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0,
+                                  causal, interpret=True)
+
+    # jnp reference: the identities from _ring_core_bwd
+    s = jnp.einsum("bqd,bkd->bqk", q, k)
+    if causal:
+        s = flash.causal_mask_scores(s, qpos0, kpos0)
+    p = jnp.exp(s - lse)
+    if causal:
+        p = flash.zero_masked(p, s)
+    dv_ref = jnp.einsum("bqk,bqd->bkd", p, dout)
+    dp = jnp.einsum("bqd,bkd->bqk", dout, v)
+    ds = p * (dp - D)
+    dq_ref = jnp.einsum("bqk,bkd->bqd", ds, k)
+    dk_ref = jnp.einsum("bqk,bqd->bkd", ds, q)
+    for name, g, ref in (("dq", got[0], dq_ref), ("dk", got[1], dk_ref),
+                         ("dv", got[2], dv_ref)):
+        assert np.allclose(np.asarray(g), np.asarray(ref),
+                           rtol=1e-5, atol=1e-5), \
+            (name, np.abs(np.asarray(g) - np.asarray(ref)).max())
