@@ -16,13 +16,14 @@ once per step. Inside one invocation the grid tiles BOTH dimensions —
 carries per q-tile — bounding VMEM at O(q_tile·d) instead of O(sq·d) and
 extending the kernel to sequence blocks far beyond one tile.
 
-Two backward paths exist. The ring schedule's re-rotating VJP calls the
-dedicated Pallas backward kernels (:func:`flash_block_grads`: a dq pass
-sweeping kv tiles innermost and a dk/dv pass sweeping q tiles innermost —
-logits recomputed per tile in VMEM, never materialized in HBM).
-``block_attend``'s own ``custom_vjp`` (the Ulysses/local path) recomputes
-through the jnp formulation under ``jax.vjp`` (nothing but the carries is
-saved). CPU tests run every kernel with ``interpret=True``.
+Backward: BOTH schedules' custom VJPs (the ring's re-rotating backward
+and the Ulysses/local one) route through :func:`flash_block_grads` — a dq
+pass sweeping kv tiles innermost and a dk/dv pass sweeping q tiles
+innermost, logits recomputed per tile in VMEM — with
+:func:`jnp_block_grads` (the same identities, KV-chunked) as the
+non-Pallas fallback. ``block_attend``'s own ``custom_vjp`` (jnp recompute
+of one block update) only covers code that differentiates the op
+directly. CPU tests run every kernel with ``interpret=True``.
 """
 
 from __future__ import annotations
@@ -277,6 +278,40 @@ def _flash_bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
     def _flush():
         dk_ref[0] = dk_s[:]
         dv_ref[0] = dv_s[:]
+
+
+def jnp_block_grads(qf, kf, vf, lse, dout, D, qpos0, kpos0, causal,
+                    kv_chunk: int | None = None):
+    """jnp twin of :func:`flash_block_grads` — the flash backward
+    identities, shared by the ring and local custom VJPs so the two
+    backward paths cannot drift. ``kv_chunk`` bounds peak logits memory
+    at O(sq·kv_chunk) by looping KV slabs (None = one slab)."""
+    sk = kf.shape[1]
+    chunk = sk if not kv_chunk else min(kv_chunk, sk)
+    if sk % chunk:
+        chunk = sk
+    dq = jnp.zeros(qf.shape[:2] + (qf.shape[2],), jnp.float32)
+    dks, dvs = [], []
+    for off in range(0, sk, chunk):
+        k_c = kf[:, off:off + chunk]
+        v_c = vf[:, off:off + chunk]
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_c,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            s = causal_mask_scores(s, qpos0, kpos0 + off)
+        p = jnp.exp(s - lse)  # normalized attention weights
+        if causal:
+            p = zero_masked(p, s)
+        dvs.append(jnp.einsum("bqk,bqd->bkd", p, dout,
+                              preferred_element_type=jnp.float32))
+        dp = jnp.einsum("bqd,bkd->bqk", dout, v_c.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - D)
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_c.astype(jnp.float32),
+                             preferred_element_type=jnp.float32)
+        dks.append(jnp.einsum("bqk,bqd->bkd", ds, qf.astype(jnp.float32),
+                              preferred_element_type=jnp.float32))
+    return dq, jnp.concatenate(dks, axis=1), jnp.concatenate(dvs, axis=1)
 
 
 def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,

@@ -147,23 +147,9 @@ def _ring_core_bwd(axis, causal, use_pallas, interpret, res, cts):
                 dq_blk, dk_blk, dv_blk = flash.flash_block_grads(
                     qf, _k, _v, lse, dout, D, _qp, _kp, causal,
                     interpret=interpret)
-                return dq + dq_blk, dk_a + dk_blk, dv_a + dv_blk
-            s = jnp.einsum("bqd,bkd->bqk", qf, _k,
-                           preferred_element_type=jnp.float32)
-            if causal:
-                s = flash.causal_mask_scores(s, _qp, _kp)
-            p = jnp.exp(s - lse)  # normalized attention weights
-            if causal:
-                p = flash.zero_masked(p, s)
-            dv_blk = jnp.einsum("bqk,bqd->bkd", p, dout,
-                                preferred_element_type=jnp.float32)
-            dp = jnp.einsum("bqd,bkd->bqk", dout, _v.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
-            ds = p * (dp - D)
-            dq_blk = jnp.einsum("bqk,bkd->bqd", ds, _k.astype(jnp.float32),
-                                preferred_element_type=jnp.float32)
-            dk_blk = jnp.einsum("bqk,bqd->bkd", ds, qf.astype(jnp.float32),
-                                preferred_element_type=jnp.float32)
+            else:
+                dq_blk, dk_blk, dv_blk = flash.jnp_block_grads(
+                    qf, _k, _v, lse, dout, D, _qp, _kp, causal)
             return dq + dq_blk, dk_a + dk_blk, dv_a + dv_blk
 
         if causal:
@@ -241,22 +227,16 @@ def heads_to_seq(x, axis):
     return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
-def _local_flash(q, k, v, causal, use_pallas, interpret,
-                 kv_chunk: int = 1024):
-    """Exact local attention in flash form: (b, s, h, d) in/out, logits
-    never materialized at O(s²) — the Pallas kernel tiles KV internally;
-    the jnp fallback loops KV chunks with the same online-softmax
-    update."""
+def _local_flash_fwd_loop(qf, kf, vf, causal, use_pallas, interpret,
+                          kv_chunk: int = 1024):
+    """Full local attention in flash form over (bh, s, d) rows, returning
+    ``(out, lse)``."""
     from ..ops import flash
 
-    b, s, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
-    qf = (q * scale).transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    m = jnp.full((b * h, s, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b * h, s, 1), jnp.float32)
-    acc = jnp.zeros((b * h, s, d), jnp.float32)
+    bh, s, d = qf.shape
+    m = jnp.full((bh, s, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, s, 1), jnp.float32)
+    acc = jnp.zeros((bh, s, d), jnp.float32)
     zero = jnp.asarray(0, jnp.int32)
     if use_pallas or interpret:
         m, l, acc = flash.block_attend(qf, kf, vf, zero, zero, causal,
@@ -269,7 +249,65 @@ def _local_flash(q, k, v, causal, use_pallas, interpret,
             m, l, acc = flash._attend_jnp(
                 qf, kf[:, off:off + chunk], vf[:, off:off + chunk],
                 zero, jnp.asarray(off, jnp.int32), causal, m, l, acc)
-    out = acc / jnp.maximum(l, 1e-30)
+    l_safe = jnp.maximum(l, 1e-30)
+    return acc / l_safe, m + jnp.log(l_safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _local_flash_core(qf, kf, vf, causal, use_pallas, interpret, kv_chunk):
+    """Differentiable full local attention with flash-style memory: like
+    :func:`_ring_core`, the custom VJP saves only (qf, kf, vf, out, lse)
+    and the backward runs the Pallas block-gradient kernels (or the
+    KV-chunked jnp identities), so the O(s²) logits never persist for
+    the backward."""
+    return _local_flash_fwd_loop(qf, kf, vf, causal, use_pallas, interpret,
+                                 kv_chunk)
+
+
+def _local_flash_core_fwd(qf, kf, vf, causal, use_pallas, interpret,
+                          kv_chunk):
+    out, lse = _local_flash_fwd_loop(qf, kf, vf, causal, use_pallas,
+                                     interpret, kv_chunk)
+    return (out, lse), (qf, kf, vf, out, lse)
+
+
+def _local_flash_core_bwd(causal, use_pallas, interpret, kv_chunk, res,
+                          cts):
+    from ..ops import flash
+
+    qf, kf, vf, out, lse = res
+    dout, _dlse = cts
+    dout = dout.astype(jnp.float32)
+    D = jnp.sum(dout * out, axis=-1, keepdims=True)
+    zero = jnp.asarray(0, jnp.int32)
+    if use_pallas or interpret:
+        dq, dk, dv = flash.flash_block_grads(qf, kf, vf, lse, dout, D,
+                                             zero, zero, causal,
+                                             interpret=interpret)
+    else:
+        # same KV chunking as the forward: peak logits O(s * kv_chunk)
+        dq, dk, dv = flash.jnp_block_grads(qf, kf, vf, lse, dout, D,
+                                           zero, zero, causal,
+                                           kv_chunk=kv_chunk)
+    return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype))
+
+
+_local_flash_core.defvjp(_local_flash_core_fwd, _local_flash_core_bwd)
+
+
+def _local_flash(q, k, v, causal, use_pallas, interpret,
+                 kv_chunk: int = 1024):
+    """Exact local attention in flash form: (b, s, h, d) in/out, logits
+    never materialized at O(s²) in forward OR backward — the Pallas
+    kernels tile both; the jnp fallback loops ``kv_chunk``-sized KV slabs
+    in both directions (peak logits O(s·kv_chunk))."""
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qf = (q * scale).transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out, _lse = _local_flash_core(qf, kf, vf, causal, bool(use_pallas),
+                                  bool(interpret), int(kv_chunk))
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(v.dtype)
 
 

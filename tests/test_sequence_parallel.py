@@ -454,3 +454,23 @@ def test_flash_backward_kernel_multi_tile(monkeypatch, causal):
         assert np.allclose(np.asarray(g), np.asarray(ref),
                            rtol=1e-5, atol=1e-5), \
             (name, np.abs(np.asarray(g) - np.asarray(ref)).max())
+
+
+def test_ulysses_residuals_are_o_sequence_constant():
+    """The local-flash custom VJP must save only (qf, kf, vf, out, lse)
+    — five leaves, no O(s^2) logits in the residual tree."""
+    from horovod_tpu.parallel.sequence import _local_flash_core_fwd
+
+    bh, s, d = 4, 16, 8
+
+    def fwd_residuals(qf, kf, vf):
+        _, res = _local_flash_core_fwd(qf, kf, vf, True, False, False, 8)
+        return res
+
+    shapes = jax.eval_shape(
+        fwd_residuals,
+        *[jax.ShapeDtypeStruct((bh, s, d), jnp.float32)] * 3)
+    leaves = jax.tree_util.tree_leaves(shapes)
+    assert len(leaves) == 5
+    for leaf in leaves:
+        assert np.prod(leaf.shape) <= bh * s * d, leaf.shape  # never s^2
