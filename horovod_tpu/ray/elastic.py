@@ -231,10 +231,17 @@ class ElasticRayExecutor:
             if not infra.driver.succeeded:
                 raise RuntimeError("elastic ray job stopped without a "
                                    "successful worker")
+            # Only workers holding a slot in the FINAL round contribute
+            # results: a worker from an earlier shrunk round that exited 0
+            # on a slot the last round never reused would otherwise inject
+            # a stale/duplicate result (ADVICE r4).
+            final_slots = {(s.hostname, s.local_rank)
+                           for slots in infra.driver.host_assignments.values()
+                           for s in slots}
             out = []
             with self._handles_lock:
-                for handle in self._handles.values():
-                    if handle.poll() == 0:
+                for key, handle in self._handles.items():
+                    if key in final_slots and handle.poll() == 0:
                         out.append(handle.result())
             return out
         finally:

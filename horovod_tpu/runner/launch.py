@@ -84,6 +84,13 @@ def parse_args(argv=None):
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--env", action="append", default=[],
                         metavar="NAME=VALUE", help="extra env for workers")
+    parser.add_argument("--launcher", choices=("auto", "local", "lsf"),
+                        default="auto",
+                        help="host-source escape hatch: 'auto' derives "
+                             "hosts from a detected LSF allocation when no "
+                             "-H/--hostfile is given, 'local' ignores "
+                             "scheduler env, 'lsf' requires an LSF "
+                             "allocation and fails loudly without one")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the training command")
     args = parser.parse_args(argv)
@@ -143,13 +150,29 @@ def _explicit_dests(argv, parser) -> set:
 
 
 def _resolve_hosts(args) -> list[hosts_mod.HostSpec]:
+    from . import lsf
+
     if args.hosts and args.hostfile:
         raise ValueError("--hosts and --hostfile are mutually exclusive")
+    launcher = getattr(args, "launcher", "auto")
+    if launcher == "lsf" and not lsf.using_lsf():
+        raise RuntimeError("--launcher lsf: no LSF allocation detected "
+                           "(LSB_JOBID not set)")
+    specs = None
     if args.hosts:
         specs = hosts_mod.parse_hosts(args.hosts)
     elif args.hostfile:
         specs = hosts_mod.parse_hostfile(args.hostfile)
-    else:
+    elif launcher != "local" and lsf.using_lsf():
+        # hvdrun inside an LSF allocation: hosts come from the allocation
+        # itself (reference launch.py does the same via LSFUtils)
+        try:
+            specs = lsf.lsf_host_specs()
+        except RuntimeError:
+            if launcher == "lsf":
+                raise  # explicitly requested: fail loudly
+            # auto: LSB_JOBID present but no usable host env — fall through
+    if specs is None:
         specs = [hosts_mod.HostSpec("localhost", args.np or 1)]
     if args.slots_per_host:
         specs = [hosts_mod.HostSpec(h.hostname, args.slots_per_host)

@@ -194,12 +194,14 @@ def _maybe_distributed_init() -> None:
 
 
 # (world-size var, per-process rank var): the rank var is only set inside
-# an actual srun/mpirun task — an `#SBATCH --ntasks=8` script running
+# an actual srun/mpirun/jsrun task — an `#SBATCH --ntasks=8` script running
 # plain `python` exports SLURM_NTASKS but no SLURM_PROCID, and must NOT
-# trigger a blocking multi-process join.
+# trigger a blocking multi-process join. JSM_* is IBM JSM, what `jsrun`
+# sets on LSF clusters (reference `js_run.py:1-151`).
 _CLUSTER_ENV_PAIRS = (("SLURM_NTASKS", "SLURM_PROCID"),
                       ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
-                      ("PMI_SIZE", "PMI_RANK"))
+                      ("PMI_SIZE", "PMI_RANK"),
+                      ("JSM_NAMESPACE_SIZE", "JSM_NAMESPACE_RANK"))
 
 
 def _cluster_world_hint() -> int:
@@ -216,6 +218,30 @@ def _cluster_world_hint() -> int:
     return 1
 
 
+def _jsm_init_kwargs() -> dict:
+    """Explicit ``jax.distributed.initialize`` kwargs for ``jsrun``-launched
+    tasks. jax's built-in cluster detection covers SLURM and Open MPI but
+    not IBM JSM, so when only JSM env is present the coordinator is derived
+    from the LSF allocation itself: rank 0 lives on the first host of
+    ``LSB_DJOB_RANKFILE`` (reference jsrun host source, ``js_run.py``;
+    rankfile parsing shared with :mod:`horovod_tpu.runner.lsf`). Returns
+    ``{}`` (let jax auto-detect) when JSM env is absent or another
+    supported scheduler's rank var is also present."""
+    if os.environ.get("JSM_NAMESPACE_RANK") is None:
+        return {}
+    if (os.environ.get("SLURM_PROCID") is not None
+            or os.environ.get("OMPI_COMM_WORLD_RANK") is not None):
+        return {}  # jax's own detectors know these; prefer them
+    from .runner import lsf as lsf_mod
+    first_host = lsf_mod.lsf_host_specs()[0].hostname
+    port = envs.get(envs.COORDINATOR_PORT, "9778")
+    return dict(
+        coordinator_address=f"{first_host}:{port}",
+        num_processes=int(os.environ["JSM_NAMESPACE_SIZE"]),
+        process_id=int(os.environ["JSM_NAMESPACE_RANK"]),
+    )
+
+
 def _maybe_cluster_autodetect() -> None:
     """`srun python train.py` / `mpirun -np N python train.py` parity:
     when a scheduler advertises a multi-process world and no launcher env
@@ -224,7 +250,8 @@ def _maybe_cluster_autodetect() -> None:
     if _cluster_world_hint() <= 1:
         return
     try:
-        jax.distributed.initialize()  # jax auto-detects SLURM/OMPI
+        kwargs = _jsm_init_kwargs()  # jsrun/LSF: jax has no JSM detector
+        jax.distributed.initialize(**kwargs)  # jax auto-detects SLURM/OMPI
         hvd_logging.info(
             "jax.distributed auto-initialized from cluster env: "
             "process %d/%d", jax.process_index(), jax.process_count())
@@ -252,6 +279,40 @@ def _distributed_kv_client():
         return None
 
 
+def _kv_advertise_address() -> str:
+    """The address peers should dial for the bootstrap KV server: the NIC
+    that routes to the jax.distributed coordinator (UDP-connect trick, no
+    packet leaves the host), because on multi-NIC hosts the first entry of
+    ``local_addresses()`` may be unroutable from peers and negotiation
+    would silently hang (ADVICE r4). Falls back to ``local_addresses()[0]``
+    when no coordinator is known."""
+    import socket
+
+    coord = None
+    try:
+        from jax._src import distributed as _dist
+        coord = _dist.global_state.coordinator_address
+    except Exception:  # pragma: no cover - private API moved
+        pass
+    if not coord:
+        addr = envs.get(envs.COORDINATOR_ADDR)
+        if addr:
+            coord = f"{addr}:{envs.get(envs.COORDINATOR_PORT, '9778')}"
+    if coord:
+        host, _, port = coord.rpartition(":")
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((host or coord, int(port) if port.isdigit() else 9778))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            pass
+    from .runner.http_kv import local_addresses
+    return local_addresses()[0]
+
+
 def _maybe_bootstrap_kv() -> None:
     """Stand up the negotiation/rendezvous KV for worlds NOT launched by
     ``hvdrun`` (srun/mpirun/user-initialized jax.distributed): process 0
@@ -270,12 +331,12 @@ def _maybe_bootstrap_kv() -> None:
     key = _KV_BOOTSTRAP_KEY.format(_generation)
     try:
         if jax.process_index() == 0:
-            from .runner.http_kv import KVServer, local_addresses, make_secret
+            from .runner.http_kv import KVServer, make_secret
             secret = make_secret()
             server = KVServer(secret=secret)
             port = server.start()
             _bootstrap_kv_server = server
-            payload = f"{local_addresses()[0]}:{port}:{secret}"
+            payload = f"{_kv_advertise_address()}:{port}:{secret}"
             client.key_value_set(key, payload)
         else:
             payload = client.blocking_key_value_get(key, 60_000)

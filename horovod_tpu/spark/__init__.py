@@ -178,6 +178,11 @@ def run(fn: Callable, args=(), kwargs: dict | None = None,
                 f"expected 0..{num_proc - 1}")
         return [v for _, v in pairs]
     finally:
+        # Orderly teardown: cancelJobGroup is best-effort and the daemon
+        # _drive thread may still sit in collect(); give the cancellation
+        # a moment to unwind before the KV dies, so straggler barrier
+        # tasks fail against a cancelled job, not a vanished KV (ADVICE r4).
+        thread.join(timeout=10.0)
         kv.stop()
 
 

@@ -1,0 +1,163 @@
+"""LSF / jsrun launch parity (reference ``runner/js_run.py:1-151``,
+``runner/util/lsf.py:1-103``): allocation detection, host derivation from
+LSF env, the ``hvdrun --launcher`` escape hatch, and in-task JSM rank
+detection."""
+
+import pytest
+
+from horovod_tpu.runner import launch, lsf
+from horovod_tpu.runner.hosts import HostSpec
+
+
+def _clear_lsf_env(monkeypatch):
+    for var in ("LSB_JOBID", "LSB_DJOB_RANKFILE", "LSB_MCPU_HOSTS",
+                "LSB_HOSTS", "JSM_NAMESPACE_RANK", "JSM_NAMESPACE_SIZE",
+                "SLURM_PROCID", "SLURM_NTASKS", "OMPI_COMM_WORLD_RANK",
+                "OMPI_COMM_WORLD_SIZE", "PMI_RANK", "PMI_SIZE"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_using_lsf(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    assert not lsf.using_lsf()
+    monkeypatch.setenv("LSB_JOBID", "12345")
+    assert lsf.using_lsf()
+
+
+def test_host_specs_from_rankfile(monkeypatch, tmp_path):
+    _clear_lsf_env(monkeypatch)
+    rankfile = tmp_path / "rankfile"
+    rankfile.write_text("nodeA\nnodeA\nnodeB\nnodeB\nnodeA\n")
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rankfile))
+    specs = lsf.lsf_host_specs()
+    # first-appearance order: rank 0 must land on the first rankfile host
+    assert specs == [HostSpec("nodeA", 3), HostSpec("nodeB", 2)]
+
+
+def test_host_specs_from_mcpu_hosts(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 2")
+    assert lsf.lsf_host_specs() == [HostSpec("nodeA", 4), HostSpec("nodeB", 2)]
+
+
+def test_host_specs_from_lsb_hosts(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_HOSTS", "nodeB nodeB nodeA")
+    assert lsf.lsf_host_specs() == [HostSpec("nodeB", 2), HostSpec("nodeA", 1)]
+
+
+def test_host_specs_without_lsf_info_raises(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    with pytest.raises(RuntimeError, match="pass -H/--hostfile"):
+        lsf.lsf_host_specs()
+
+
+def test_rankfile_beats_mcpu_hosts(monkeypatch, tmp_path):
+    """LSB_DJOB_RANKFILE is per-slot truth; it wins over the summary var."""
+    _clear_lsf_env(monkeypatch)
+    rankfile = tmp_path / "rankfile"
+    rankfile.write_text("nodeX\nnodeX\n")
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rankfile))
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeY 8")
+    assert lsf.lsf_host_specs() == [HostSpec("nodeX", 2)]
+
+
+def test_resolve_hosts_uses_lsf_allocation(monkeypatch, tmp_path):
+    """hvdrun inside an LSF allocation with no -H/--hostfile derives hosts
+    from the allocation (reference launch.py via LSFUtils)."""
+    _clear_lsf_env(monkeypatch)
+    rankfile = tmp_path / "rankfile"
+    rankfile.write_text("nodeA\nnodeB\n")
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rankfile))
+    args = launch.parse_args(["--", "python", "train.py"])
+    assert launch._resolve_hosts(args) == [HostSpec("nodeA", 1),
+                                           HostSpec("nodeB", 1)]
+
+
+def test_launcher_local_ignores_lsf(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4")
+    args = launch.parse_args(
+        ["--launcher", "local", "-np", "2", "--", "python", "train.py"])
+    assert launch._resolve_hosts(args) == [HostSpec("localhost", 2)]
+
+
+def test_launcher_lsf_requires_allocation(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    args = launch.parse_args(
+        ["--launcher", "lsf", "--", "python", "train.py"])
+    with pytest.raises(RuntimeError, match="no LSF allocation"):
+        launch._resolve_hosts(args)
+
+
+def test_explicit_hosts_beat_lsf(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeZ 8")
+    args = launch.parse_args(["-H", "me:2", "--", "python", "train.py"])
+    assert launch._resolve_hosts(args) == [HostSpec("me", 2)]
+
+
+def test_cluster_world_hint_jsm(monkeypatch):
+    """jsrun tasks advertise JSM_NAMESPACE_SIZE/RANK; the batch-level var
+    alone (no rank) must not trigger a join — same contract as srun."""
+    from horovod_tpu import runtime as rt
+    _clear_lsf_env(monkeypatch)
+    assert rt._cluster_world_hint() == 1
+    monkeypatch.setenv("JSM_NAMESPACE_SIZE", "4")
+    assert rt._cluster_world_hint() == 1  # no rank var: not inside a task
+    monkeypatch.setenv("JSM_NAMESPACE_RANK", "2")
+    assert rt._cluster_world_hint() == 4
+
+
+def test_jsm_init_kwargs(monkeypatch, tmp_path):
+    from horovod_tpu import runtime as rt
+    _clear_lsf_env(monkeypatch)
+    assert rt._jsm_init_kwargs() == {}
+    rankfile = tmp_path / "rankfile"
+    # Summit layout: the launch (batch) node leads the rankfile but jsrun
+    # never places a rank there — the coordinator must land on the first
+    # COMPUTE node or every rank hangs dialing a host with no rank 0.
+    rankfile.write_text("batch2\nworker1\nworker2\n")
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rankfile))
+    monkeypatch.setenv("JSM_NAMESPACE_SIZE", "2")
+    monkeypatch.setenv("JSM_NAMESPACE_RANK", "1")
+    kw = rt._jsm_init_kwargs()
+    assert kw["coordinator_address"].startswith("worker1:")
+    assert kw["num_processes"] == 2 and kw["process_id"] == 1
+    # SLURM rank var present too: defer to jax's own detector
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    assert rt._jsm_init_kwargs() == {}
+
+
+def test_launch_nodes_filtered_only_when_compute_hosts_remain(monkeypatch):
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    # batch node leads with 1 slot (Summit convention): filtered out
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "batch1 1 c35n04 42 c35n05 42")
+    assert lsf.lsf_host_specs() == [HostSpec("c35n04", 42),
+                                    HostSpec("c35n05", 42)]
+    # single-host job ON a batch-named node: nothing else left, keep it
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "batch1 4")
+    assert lsf.lsf_host_specs() == [HostSpec("batch1", 4)]
+
+
+def test_launcher_auto_falls_back_to_localhost(monkeypatch):
+    """LSB_JOBID set but no usable host env: --launcher auto must degrade
+    to the localhost default instead of crashing (--launcher lsf raises)."""
+    _clear_lsf_env(monkeypatch)
+    monkeypatch.setenv("LSB_JOBID", "1")
+    args = launch.parse_args(["-np", "2", "--", "python", "train.py"])
+    assert launch._resolve_hosts(args) == [HostSpec("localhost", 2)]
+    args = launch.parse_args(
+        ["--launcher", "lsf", "-np", "2", "--", "python", "train.py"])
+    with pytest.raises(RuntimeError, match="pass -H/--hostfile"):
+        launch._resolve_hosts(args)
