@@ -96,7 +96,7 @@ def _tile_causal_mask(s, qpos_ref, kpos_ref, qi, j, q_tile, kv_tile):
 
 def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
                   acc_ref, mo_ref, lo_ref, acco_ref, m_s, l_s, acc_s, *,
-                  causal, q_tile, kv_tile):
+                  causal, q_tile, kv_tile, sk_valid):
     qi = pl.program_id(1)  # q-tile index (kv sweep is the innermost dim,
     j = pl.program_id(2)   # so scratch carries are per-(bh, q-tile))
     n_kv = pl.num_programs(2)
@@ -115,12 +115,14 @@ def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
         preferred_element_type=jnp.float32)  # (q_tile, kv_tile), MXU
     if causal:
         s = _tile_causal_mask(s, qpos_ref, kpos_ref, qi, j, q_tile, kv_tile)
+    if sk_valid is not None:
+        s = _tile_pad_mask(s, j, kv_tile, sk_valid)
     m_prev = m_s[:]       # (q_tile, 1) f32
     l_prev = l_s[:]
     acc_prev = acc_s[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
-    if causal:
+    if causal or sk_valid is not None:
         # fully-masked rows: m_new may still be the NEG_INF sentinel, making
         # exp(s - m_new) == 1 at masked entries — zero them (see _attend_jnp)
         p = jnp.where(s > NEG_INF / 2, p, 0.0)
@@ -139,18 +141,46 @@ def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
         acco_ref[0] = acc_s[:]
 
 
-def _pick_tile(size: int, default: int) -> int:
-    """Largest divisor of ``size`` that is <= ``default`` — the VMEM bound
-    must hold for ragged sizes too (a whole-dimension fallback would
-    silently undo the tiling for e.g. prime-ish long sequences)."""
-    if size <= default:
-        return size
-    if size % default == 0:
-        return default
-    for t in range(default, 0, -1):
-        if size % t == 0:
-            return t
-    return size  # unreachable (t=1 always divides)
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _tile_pad(size: int, default: int) -> tuple[int, int]:
+    """``(tile, padded)``: tile <= default, ``padded`` the next tile
+    multiple covering ``size``. Awkward (prime-ish) sizes PAD to the next
+    tile boundary instead of shrinking the tile to a divisor — a divisor
+    search hands e.g. sq=8191 a tile of 1, a grid of 1-row MXU ops and a
+    Mosaic layout cliff (ADVICE r4). The padded tail is masked to the
+    NEG_INF sentinel via ``sk_valid`` (kv) or zero inputs (q); sub-default
+    sizes round up to the fp32 sublane quantum (8) so Mosaic gets an
+    aligned block."""
+    if size >= default:
+        # A size just past a tile boundary would pay up to ~2x padded
+        # compute at the full default tile (e.g. 1025 -> 2048): try the
+        # default and two halvings, keep the least total padding (larger
+        # tile on ties — fewer grid steps).
+        cands = [t for t in (default, default // 2, default // 4)
+                 if t >= 8] or [default]
+        tile = min(cands, key=lambda t: (_round_up(size, t), -t))
+        return tile, _round_up(size, tile)
+    t = _round_up(size, 8)
+    return t, t
+
+
+def _pad_dim1(x, target: int):
+    """Zero-pad dim 1 (the sequence dim of a (bh, s, d) block) to target."""
+    if x.shape[1] == target:
+        return x
+    return jnp.pad(x, ((0, 0), (0, target - x.shape[1]), (0, 0)))
+
+
+def _tile_pad_mask(s, j, kv_tile, sk_valid):
+    """NEG_INF-mask score columns past the true (pre-padding) kv length.
+    Shared by the forward and both backward kernels, like the causal
+    twin :func:`_tile_causal_mask`."""
+    tq, tk = s.shape
+    kcol = j * kv_tile + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return jnp.where(kcol < sk_valid, s, NEG_INF)
 
 
 def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
@@ -158,13 +188,20 @@ def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
 
     bh, sq, d = q.shape
     sk = k.shape[1]
-    kv_tile = _pick_tile(sk, DEFAULT_KV_TILE)
-    q_tile = _pick_tile(sq, DEFAULT_Q_TILE)
-    n_kv = sk // kv_tile
-    n_q = sq // q_tile
+    kv_tile, sk_p = _tile_pad(sk, DEFAULT_KV_TILE)
+    q_tile, sq_p = _tile_pad(sq, DEFAULT_Q_TILE)
+    # Zero-pad to the tile grid; padded kv columns are NEG_INF-masked in
+    # the kernel (sk_valid) and padded q rows are sliced off below (their
+    # carries are well-defined: zero q rows give s=0 scores, no NaNs).
+    q, k, v = _pad_dim1(q, sq_p), _pad_dim1(k, sk_p), _pad_dim1(v, sk_p)
+    m, l, acc = (_pad_dim1(m, sq_p), _pad_dim1(l, sq_p),
+                 _pad_dim1(acc, sq_p))
+    n_kv = sk_p // kv_tile
+    n_q = sq_p // q_tile
     kernel = functools.partial(_flash_kernel, causal=causal,
-                               q_tile=q_tile, kv_tile=kv_tile)
-    return pl.pallas_call(
+                               q_tile=q_tile, kv_tile=kv_tile,
+                               sk_valid=sk if sk_p != sk else None)
+    out = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_kv),
         in_specs=[
@@ -183,9 +220,9 @@ def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
             pl.BlockSpec((1, q_tile, d), lambda i, qi, j: (i, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_p, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_p, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_p, d), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((q_tile, 1), jnp.float32),
@@ -196,6 +233,9 @@ def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
     )(jnp.asarray([qpos0], jnp.int32).reshape(1),
       jnp.asarray([kpos0], jnp.int32).reshape(1),
       q, k, v, m, l, acc)
+    if sq_p != sq:
+        out = [o[:, :sq] for o in out]
+    return tuple(out)
 
 
 # --------------------------------------------------------------------------
@@ -209,22 +249,25 @@ def _flash_call(q, k, v, qpos0, kpos0, causal, m, l, acc, interpret):
 
 
 def _bwd_scores(q, k, qpos_ref, kpos_ref, lse, qi, j, q_tile, kv_tile,
-                causal):
+                causal, sk_valid):
     """Recompute the normalized softmax block p = exp(s - lse), masked by
-    the SAME :func:`_tile_causal_mask` the forward kernel uses."""
+    the SAME :func:`_tile_causal_mask` / :func:`_tile_pad_mask` the
+    forward kernel uses."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if causal:
         s = _tile_causal_mask(s, qpos_ref, kpos_ref, qi, j, q_tile, kv_tile)
+    if sk_valid is not None:
+        s = _tile_pad_mask(s, j, kv_tile, sk_valid)
     p = jnp.exp(s - lse)
-    if causal:
+    if causal or sk_valid is not None:
         p = jnp.where(s > NEG_INF / 2, p, 0.0)
     return p
 
 
 def _flash_bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
                          d_ref, do_ref, dq_ref, dq_s, *, causal, q_tile,
-                         kv_tile):
+                         kv_tile, sk_valid):
     qi = pl.program_id(1)
     j = pl.program_id(2)  # kv sweep innermost: dq accumulates per q tile
     n_kv = pl.num_programs(2)
@@ -234,7 +277,7 @@ def _flash_bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
         dq_s[:] = jnp.zeros_like(dq_s)
 
     p = _bwd_scores(q_ref[0], k_ref[0], qpos_ref, kpos_ref, lse_ref[0],
-                    qi, j, q_tile, kv_tile, causal)
+                    qi, j, q_tile, kv_tile, causal, sk_valid)
     do = do_ref[0]
     dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -250,7 +293,7 @@ def _flash_bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
 
 def _flash_bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
                           d_ref, do_ref, dk_ref, dv_ref, dk_s, dv_s, *,
-                          causal, q_tile, kv_tile):
+                          causal, q_tile, kv_tile, sk_valid):
     j = pl.program_id(1)
     qi = pl.program_id(2)  # q sweep innermost: dk/dv accumulate per kv tile
     n_q = pl.num_programs(2)
@@ -262,7 +305,7 @@ def _flash_bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, lse_ref,
 
     q = q_ref[0]
     p = _bwd_scores(q, k_ref[0], qpos_ref, kpos_ref, lse_ref[0],
-                    qi, j, q_tile, kv_tile, causal)
+                    qi, j, q_tile, kv_tile, causal, sk_valid)
     do = do_ref[0]
     dv_s[:] += jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -326,9 +369,16 @@ def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,
 
     bh, sq, d = q.shape
     sk = k.shape[1]
-    q_tile = _pick_tile(sq, DEFAULT_Q_TILE)
-    kv_tile = _pick_tile(sk, DEFAULT_KV_TILE)
-    n_q, n_kv = sq // q_tile, sk // kv_tile
+    q_tile, sq_p = _tile_pad(sq, DEFAULT_Q_TILE)
+    kv_tile, sk_p = _tile_pad(sk, DEFAULT_KV_TILE)
+    sk_valid = sk if sk_p != sk else None
+    # Zero-pad to the tile grid (see _flash_call): padded kv columns are
+    # sk_valid-masked; padded q rows contribute nothing because dout (and
+    # hence dp, ds, and the dv outer product) is zero there.
+    q, dout = _pad_dim1(q, sq_p), _pad_dim1(dout, sq_p)
+    lse, D = _pad_dim1(lse, sq_p), _pad_dim1(D, sq_p)
+    k, v = _pad_dim1(k, sk_p), _pad_dim1(v, sk_p)
+    n_q, n_kv = sq_p // q_tile, sk_p // kv_tile
     qpos0 = jnp.asarray([qpos0], jnp.int32).reshape(1)
     kpos0 = jnp.asarray([kpos0], jnp.int32).reshape(1)
     pos_spec = pl.BlockSpec((1,), lambda i, a, b: (0,))
@@ -339,7 +389,7 @@ def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                          q_tile=q_tile, kv_tile=kv_tile),
+                          q_tile=q_tile, kv_tile=kv_tile, sk_valid=sk_valid),
         grid=(bh, n_q, n_kv),
         in_specs=[pos_spec, pos_spec,
                   q_spec_dq(d),
@@ -347,7 +397,7 @@ def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,
                   pl.BlockSpec((1, kv_tile, d), lambda i, qi, j: (i, j, 0)),
                   q_spec_dq(1), q_spec_dq(1), q_spec_dq(d)],
         out_specs=q_spec_dq(d),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((q_tile, d), jnp.float32)],
         interpret=interpret,
     )(qpos0, kpos0, q, k, v, lse, D, dout)
@@ -355,7 +405,7 @@ def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,
     kv_spec = pl.BlockSpec((1, kv_tile, d), lambda i, j, qi: (i, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal,
-                          q_tile=q_tile, kv_tile=kv_tile),
+                          q_tile=q_tile, kv_tile=kv_tile, sk_valid=sk_valid),
         grid=(bh, n_kv, n_q),
         in_specs=[pos_spec, pos_spec,
                   pl.BlockSpec((1, q_tile, d), lambda i, j, qi: (i, qi, 0)),
@@ -364,13 +414,13 @@ def flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal,
                   pl.BlockSpec((1, q_tile, 1), lambda i, j, qi: (i, qi, 0)),
                   pl.BlockSpec((1, q_tile, d), lambda i, j, qi: (i, qi, 0))],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
-                   jax.ShapeDtypeStruct((bh, sk, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sk_p, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((kv_tile, d), jnp.float32),
                         pltpu.VMEM((kv_tile, d), jnp.float32)],
         interpret=interpret,
     )(qpos0, kpos0, q, k, v, lse, D, dout)
-    return dq, dk, dv
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))

@@ -394,15 +394,78 @@ def test_ulysses_blockwise_local_attention():
     assert np.allclose(out, expect, rtol=1e-4, atol=1e-5)
 
 
-def test_flash_pick_tile_bounds_ragged_sizes():
-    """Ragged dims must still be tiled (largest divisor <= default), not
-    fall back to one whole-dimension tile that unbounds VMEM."""
-    from horovod_tpu.ops.flash import _pick_tile
+def test_flash_tile_pad_bounds_ragged_sizes():
+    """Ragged dims keep the DEFAULT tile and pad to the next tile boundary
+    — a divisor search would hand a prime size a tile of 1 (1-row MXU
+    grid, ADVICE r4) and a whole-dimension fallback would unbound VMEM."""
+    from horovod_tpu.ops.flash import _tile_pad
 
-    assert _pick_tile(16, 1024) == 16       # small: one tile
-    assert _pick_tile(4096, 1024) == 1024   # exact multiple
-    assert _pick_tile(24, 10) == 8          # ragged: largest divisor <= 10
-    assert _pick_tile(7919, 1024) == 1      # prime: still bounded
+    assert _tile_pad(16, 1024) == (16, 16)        # small: one aligned tile
+    assert _tile_pad(4096, 1024) == (1024, 4096)  # exact multiple
+    assert _tile_pad(12, 1024) == (16, 16)        # small ragged: 8-aligned
+    assert _tile_pad(7919, 1024) == (256, 7936)   # prime: pad, NOT tile=1
+    # just past a boundary: a halved tile cuts the padding waste ~4x
+    assert _tile_pad(1025, 1024) == (256, 1280)
+    assert _tile_pad(1536, 1024) == (512, 1536)   # exact at a halving
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_kernel_awkward_sizes(causal):
+    """Prime-ish sq/sk exercise the pad-and-mask path: padded kv columns
+    must not leak into (m, l, acc) and padded q rows are sliced off."""
+    from horovod_tpu.ops import flash
+
+    bh, sq, sk, d = 2, 13, 11, 8  # neither a multiple of anything useful
+    rng = np.random.default_rng(31)
+    q = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    m = jnp.full((bh, sq, 1), flash.NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, sq, 1), jnp.float32)
+    acc = jnp.zeros((bh, sq, d), jnp.float32)
+    qpos0 = jnp.asarray(3, jnp.int32)
+    kpos0 = jnp.asarray(0, jnp.int32)
+    got = flash.block_attend(q, k, v, qpos0, kpos0, causal, True, m, l, acc)
+    want = flash._attend_jnp(q, k, v, qpos0, kpos0, causal, m, l, acc)
+    for name, g, w in zip(("m", "l", "acc"), got, want):
+        assert g.shape == w.shape, name
+        assert np.allclose(np.asarray(g), np.asarray(w),
+                           rtol=1e-5, atol=1e-5), name
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_kernel_awkward_sizes(monkeypatch, causal):
+    """flash_block_grads with non-tile-aligned sq/sk: the padded tail of
+    kv is masked and padded q rows carry zero dout, so gradients match
+    the unpadded jnp identities exactly."""
+    from horovod_tpu.ops import flash
+
+    monkeypatch.setattr(flash, "DEFAULT_Q_TILE", 8)
+    monkeypatch.setattr(flash, "DEFAULT_KV_TILE", 8)
+    bh, sq, sk, d = 2, 13, 11, 8  # pads to 16 q x 16 kv
+    rng = np.random.default_rng(37)
+    q = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    dout = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    qpos0 = jnp.asarray(2, jnp.int32)
+    kpos0 = jnp.asarray(0, jnp.int32)
+    m = jnp.full((bh, sq, 1), flash.NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, sq, 1), jnp.float32)
+    acc = jnp.zeros((bh, sq, d), jnp.float32)
+    m1, l1, acc1 = flash._attend_jnp(q, k, v, qpos0, kpos0, causal,
+                                     m, l, acc)
+    l_safe = jnp.maximum(l1, 1e-30)
+    lse = m1 + jnp.log(l_safe)
+    D = jnp.sum(dout * (acc1 / l_safe), axis=-1, keepdims=True)
+    got = flash.flash_block_grads(q, k, v, lse, dout, D, qpos0, kpos0,
+                                  causal, interpret=True)
+    want = flash.jnp_block_grads(q, k, v, lse, dout, D, qpos0, kpos0, causal)
+    for name, g, w in zip(("dq", "dk", "dv"), got, want):
+        assert g.shape == w.shape, name
+        assert np.allclose(np.asarray(g), np.asarray(w),
+                           rtol=1e-4, atol=1e-4), \
+            (name, np.abs(np.asarray(g) - np.asarray(w)).max())
 
 
 @pytest.mark.parametrize("causal", [True, False])
