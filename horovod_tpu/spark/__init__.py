@@ -22,13 +22,14 @@ per rank). The rebuild is deliberately thin and Spark-native:
 
     results = horovod_tpu.spark.run(train_fn, args=(cfg,), num_proc=4)
 
-The reference's Petastorm estimator framework (``horovod/spark/keras``,
-``spark/lightning``, ``spark/common/store.py``) is a documented non-goal:
-it adapts TF/Torch DataLoaders to Parquet stores, which has no analog in
-the jax input pipeline (use :mod:`horovod_tpu.data` loaders instead).
-Only the ``run()`` entry point — every rank is a Spark task — is in
-scope. pyspark itself is imported lazily: the module imports fine without
-Spark installed.
+The reference's Petastorm machinery (``horovod/spark/keras``,
+``spark/lightning`` adapting Parquet stores to TF/Torch DataLoaders) is a
+documented non-goal — it has no analog in the jax input pipeline. The
+estimator *role* itself (train from data, Store-backed checkpoints,
+resume) IS covered by the lite bridge in
+:mod:`horovod_tpu.spark.estimator`: :func:`fit`, :func:`fit_dataframe`,
+:func:`save_dataset`. pyspark is imported lazily: the module imports
+fine without Spark installed.
 """
 
 from __future__ import annotations
@@ -193,3 +194,6 @@ def _make_task(fn, args, kwargs, secret, kv_addr, kv_port, extra_env):
         return _task_body(fn, args, kwargs, secret, kv_addr, kv_port,
                           extra_env)
     return _task
+
+
+from .estimator import fit, fit_dataframe, save_dataset  # noqa: E402

@@ -1,0 +1,176 @@
+"""Estimator-lite: train a model *from data* on Spark.
+
+Role parity (role, not API) with the reference's Spark estimator layer —
+``/root/reference/horovod/spark/keras/estimator.py`` /
+``spark/lightning/estimator.py`` backed by a Store
+(``spark/common/store.py:1-582``): the user hands data + a model recipe to
+the driver and gets trained parameters back, with checkpoints persisted.
+The reference materializes DataFrames to Parquet via Petastorm and adapts
+them to TF/Torch loaders; that machinery has no jax analog and stays out
+of scope (documented in :mod:`horovod_tpu.spark`). The lite bridge keeps
+the estimator *role* with the framework's own pieces:
+
+* placement/launch — :func:`horovod_tpu.spark.run` barrier tasks;
+* data — :class:`horovod_tpu.data.ShardedArrayLoader` over in-memory
+  arrays or an ``.npz`` on storage every executor can read;
+* the Store — :class:`horovod_tpu.checkpoint.Checkpointer` (orbax) at
+  ``store_path``: per-epoch checkpoints, automatic resume from the
+  latest one.
+
+    params = fit((features, labels), init_fn, loss_fn,
+                 epochs=3, batch_size=64, num_proc=4,
+                 store_path="/shared/run1")
+
+``init_fn(rng, batch) -> params`` builds the model parameters;
+``loss_fn(params, batch) -> scalar`` is differentiated. Gradients sync
+through :class:`~horovod_tpu.optim.DistributedOptimizer` under ``jit``
+(GSPMD inserts the cross-rank reduction for the sharded batch).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Sequence
+
+__all__ = ["fit", "fit_dataframe", "save_dataset"]
+
+
+def save_dataset(store_path: str, *arrays) -> str:
+    """Materialize arrays to ``<store_path>/dataset.npz`` (the Store role
+    for inputs: one write on the driver, readable by every executor over
+    shared storage). Returns the ``.npz`` path, accepted by :func:`fit`."""
+    import numpy as np
+
+    os.makedirs(store_path, exist_ok=True)
+    path = os.path.join(store_path, "dataset.npz")
+    np.savez(path, **{f"arr_{i}": a for i, a in enumerate(arrays)})
+    return path
+
+
+def _load_data(data) -> tuple:
+    import numpy as np
+
+    if isinstance(data, str):
+        with np.load(data) as npz:
+            return tuple(npz[k] for k in sorted(
+                npz.files, key=lambda k: int(k.split("_")[-1])))
+    return tuple(np.asarray(a) for a in data)
+
+
+def _fit_task(data, init_fn, loss_fn, optimizer, epochs, batch_size,
+              shuffle, seed, store_path):
+    """Runs on every rank (inside a barrier task): shard, train, checkpoint."""
+    import jax
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from ..checkpoint import Checkpointer
+    from ..data import ShardedArrayLoader
+
+    hvd.init()
+    arrays = _load_data(data)
+    loader = ShardedArrayLoader(*arrays, batch_size=batch_size,
+                                shuffle=shuffle, seed=seed)
+    if len(loader) == 0:
+        raise ValueError(
+            f"batch_size {batch_size} exceeds the dataset "
+            f"({len(arrays[0])} rows): zero batches per epoch")
+
+    tx = hvd.DistributedOptimizer(optimizer or optax.adam(1e-3))
+
+    # host-side example batch (same leading dim the loader will yield)
+    example = tuple(a[:batch_size] for a in arrays)
+    params = init_fn(jax.random.PRNGKey(seed), example)
+    opt_state = tx.init(params)
+
+    start_epoch = 0
+    ckpt = None
+    if store_path:
+        ckpt = Checkpointer(os.path.join(store_path, "checkpoints"))
+        latest = ckpt.latest_step()
+        if latest is not None:  # the Store's resume semantics
+            restored = ckpt.restore(
+                step=latest, target={"params": params,
+                                     "opt_state": opt_state})
+            # back to host: restored leaves carry single-device placement,
+            # which would clash with the mesh-wide broadcast below
+            params = jax.tree.map(np.asarray, restored["params"])
+            # optimizer moments resume too — otherwise an interrupted adam
+            # run silently restarts with zeroed moments (Store contract)
+            opt_state = jax.tree.map(np.asarray, restored["opt_state"])
+            start_epoch = latest + 1
+    # Rank 0's restore is authoritative for every rank: params/opt_state
+    # values AND the resume epoch (a rank whose local store_path is empty
+    # must not run extra epochs of collectives nobody else joins).
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt_state = hvd.broadcast_parameters(opt_state, root_rank=0)
+    start_epoch = hvd.broadcast_object(start_epoch, root_rank=0)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    last_loss = None
+    for epoch in range(start_epoch, epochs):
+        loader.set_epoch(epoch)
+        loss = None
+        for batch in loader:
+            params, opt_state, loss = train_step(params, opt_state, batch)
+        if loss is not None:
+            last_loss = float(jax.block_until_ready(loss))
+        if ckpt is not None and hvd.rank() == 0:
+            ckpt.save(epoch, {"params": params, "opt_state": opt_state},
+                      wait=True)
+    if ckpt is not None:
+        ckpt.close()
+    return {"params": jax.tree.map(np.asarray, params),
+            "last_loss": last_loss,
+            "epochs_run": max(0, epochs - start_epoch)}
+
+
+def fit(data, init_fn: Callable, loss_fn: Callable, *,
+        optimizer=None, epochs: int = 1, batch_size: int = 32,
+        shuffle: bool = True, seed: int = 0, store_path: str | None = None,
+        num_proc: int | None = None, start_timeout: float | None = None,
+        env: dict | None = None) -> Any:
+    """Train on Spark executors and return the trained parameter pytree
+    (host numpy leaves). ``data`` is a sequence of arrays sharing a
+    leading dimension — e.g. ``(features, labels)``, the shapes
+    ``loss_fn`` expects — or the path of an ``.npz`` every executor can
+    read (:func:`save_dataset`). With ``store_path`` set, per-epoch
+    checkpoints land there and a rerun resumes from the latest."""
+    from . import run as spark_run
+
+    results = spark_run(
+        _fit_task,
+        args=(data, init_fn, loss_fn, optimizer, epochs, batch_size,
+              shuffle, seed, store_path),
+        num_proc=num_proc, start_timeout=start_timeout, env=env)
+    return results[0]["params"]
+
+
+def fit_dataframe(df, feature_cols: Sequence[str], label_cols: Sequence[str],
+                  init_fn: Callable, loss_fn: Callable, *,
+                  store_path: str, **fit_kwargs) -> Any:
+    """Train from a Spark DataFrame: materialize the selected columns to
+    the Store once on the driver (the reference's prepare_data role,
+    ``store.py`` + ``util.prepare_data``; here a driver-side collect —
+    the lite bridge targets datasets that fit driver memory), then
+    :func:`fit` from the materialized ``.npz``. Features with per-row
+    vectors (array columns) are stacked to 2-D."""
+    import numpy as np
+
+    cols = list(feature_cols) + list(label_cols)
+    rows = df.select(*cols).collect()
+    features = np.asarray([[row[c] for c in feature_cols] for row in rows],
+                          dtype=np.float32)
+    labels = np.asarray([[row[c] for c in label_cols] for row in rows])
+    if features.ndim == 3:  # array-typed feature columns: one per column
+        features = features.reshape(len(rows), -1)
+    if labels.shape[-1] == 1:
+        labels = labels[:, 0]
+    path = save_dataset(store_path, features, labels)
+    return fit(path, init_fn, loss_fn, store_path=store_path, **fit_kwargs)

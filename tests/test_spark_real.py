@@ -40,3 +40,28 @@ def test_real_spark_run_seeds_env(spark_session):
     for e in envs:
         assert e["HVD_SIZE"] == "2"
         assert e["HVD_KV_ADDR"] and e["HVD_SECRET_KEY"]
+
+
+def test_real_spark_estimator_fit(spark_session, tmp_path):
+    """fit(dataset) -> params over real barrier tasks (estimator-lite)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 3)).astype(np.float32)
+    y = (x @ np.array([1.0, 2.0, 3.0], np.float32))
+
+    def init_fn(_rng, batch):
+        return {"w": jnp.zeros((batch[0].shape[1], 1), jnp.float32)}
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean(((xb @ params["w"])[:, 0] - yb) ** 2)
+
+    params = hvd_spark.fit((x, y), init_fn, loss_fn,
+                           optimizer=optax.sgd(0.05), epochs=4,
+                           batch_size=16, num_proc=2,
+                           store_path=str(tmp_path / "store"))
+    mse = float(np.mean(((x @ np.asarray(params["w"]))[:, 0] - y) ** 2))
+    assert mse < float(np.mean(y ** 2))
