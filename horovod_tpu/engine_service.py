@@ -38,8 +38,14 @@ from .utils import logging as hvd_logging
 
 # Default cycle time over the HTTP KV transport. The reference's 1 ms
 # default assumes an in-process MPI transport; an HTTP KV round costs
-# single-digit milliseconds, so ticking faster only burns CPU.
+# single-digit milliseconds, so ticking faster only burns CPU — when idle.
+# When work IS in flight the service ticks event-driven instead (fresh
+# enqueues wake the loop immediately, and in-flight negotiations lower
+# the pace to DEFAULT_PENDING_CYCLE_TIME_MS), recovering the reference's
+# low-latency rationale (``operations.cc:499-506``) without idle spin;
+# HVD_ADAPTIVE_CYCLE=0 restores the fixed cadence.
 DEFAULT_KV_CYCLE_TIME_MS = 20.0
+DEFAULT_PENDING_CYCLE_TIME_MS = 2.0
 _STALL_CHECK_INTERVAL_S = 5.0
 
 
@@ -122,6 +128,7 @@ class DynamicService:
         self._joined = False
         self._failure: str | None = None
         self._shutdown = threading.Event()
+        self._tick = threading.Event()  # fresh work: skip the cycle sleep
         self._exchange_timeout = envs.get_float(envs.ELASTIC_TIMEOUT, 600.0)
         self._last_stall_check = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -210,6 +217,7 @@ class DynamicService:
                         self._pending.pop(done["name"], None)
                         self.engine.abandon(done["name"])
                     raise
+        self._tick.set()  # event-driven cycle: don't wait out the sleep
         for req in requests:
             _timeline.record(req["name"], _timeline.NEGOTIATE,
                              _timeline.PHASE_BEGIN)
@@ -257,6 +265,7 @@ class DynamicService:
 
     def stop(self):
         self._shutdown.set()
+        self._tick.set()  # the adaptive sleep waits on _tick, not _shutdown
         self._thread.join(timeout=10)
         self._fail_all("engine service stopped")
 
@@ -273,6 +282,9 @@ class DynamicService:
     def _loop(self):
         while not self._shutdown.is_set():
             start = time.monotonic()
+            # Clear BEFORE the cycle: an enqueue racing the cycle body
+            # re-sets it and the next sleep is skipped, never lost.
+            self._tick.clear()
             try:
                 self._run_cycle()
             except Exception as e:
@@ -282,8 +294,25 @@ class DynamicService:
             if self._cycle_time_from_knob:
                 self.cycle_time_s = envs.get_float(
                     envs.CYCLE_TIME, DEFAULT_KV_CYCLE_TIME_MS) / 1000.0
-            elapsed = time.monotonic() - start
-            self._shutdown.wait(max(0.0, self.cycle_time_s - elapsed))
+            cycle_s = self.cycle_time_s
+            adaptive = envs.get_bool(envs.ADAPTIVE_CYCLE, True)
+            if adaptive:
+                with self._mu:
+                    busy = bool(self._pending)
+                if busy:
+                    # in-flight negotiation: tick near the transport floor
+                    # so served-next-cycle latency is ~KV RTT, not the
+                    # idle cadence (reference 1 ms CycleTimeMs rationale)
+                    cycle_s = min(cycle_s, envs.get_float(
+                        envs.PENDING_CYCLE_TIME,
+                        DEFAULT_PENDING_CYCLE_TIME_MS) / 1000.0)
+            remaining = max(0.0, cycle_s - (time.monotonic() - start))
+            if remaining <= 0:
+                continue
+            if adaptive:
+                self._tick.wait(remaining)  # fresh enqueues end the sleep
+            else:
+                self._shutdown.wait(remaining)
 
     def _run_cycle(self):
         # Canonical batched cycle (matches dynamic.drive_cycle): bits are

@@ -36,6 +36,8 @@ HIERARCHICAL_ICI_SIZE = "HIERARCHICAL_ICI_SIZE"  # chips per ICI island; default
 # (the reference's HOROVOD_BATCH_D2D_MEMCOPIES has no knob here by
 # design: XLA fuses small copies into the compiled program, so there is
 # nothing runtime-batchable to toggle)
+ADAPTIVE_CYCLE = "ADAPTIVE_CYCLE"  # event-driven negotiation tick (default on)
+PENDING_CYCLE_TIME = "PENDING_CYCLE_TIME"  # ms; cycle floor while work is in flight
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
 GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout

@@ -589,3 +589,50 @@ class TestRandomizedSymmetry:
                 assert names.count(f"op{i}") == expected, (seed, i)
         finally:
             close_world(engines)
+
+
+class _LoopbackTransport:
+    """world=1 transport: the exchange returns this process's own frame."""
+
+    def exchange(self, cycle, req_bytes, bits, timeout):
+        return [req_bytes], [bits]
+
+
+class TestAdaptiveCycle:
+    """Event-driven negotiation tick (reference 1 ms CycleTimeMs rationale,
+    operations.cc:499-506): fresh enqueues wake the cycle loop instead of
+    waiting out the idle cadence; HVD_ADAPTIVE_CYCLE=0 restores the fixed
+    sleep."""
+
+    def _service(self, cycle_time_s):
+        from horovod_tpu.engine_service import DynamicService
+        return DynamicService(NativeEngine(world_size=1, rank=0),
+                              _LoopbackTransport(),
+                              cycle_time_s=cycle_time_s)
+
+    def test_enqueue_wakes_the_cycle(self, monkeypatch):
+        monkeypatch.delenv("HVD_ADAPTIVE_CYCLE", raising=False)
+        svc = self._service(cycle_time_s=0.5)
+        try:
+            time.sleep(0.1)  # loop is now in its long idle sleep
+            t0 = time.monotonic()
+            resp = svc.negotiate("adaptive_t", REQ_ALLREDUCE, shape=(4,))
+            took = time.monotonic() - t0
+            assert not resp.is_error
+            assert took < 0.25, f"adaptive tick did not wake the loop: {took}s"
+        finally:
+            svc.stop()
+
+    def test_fixed_cadence_with_knob_off(self, monkeypatch):
+        monkeypatch.setenv("HVD_ADAPTIVE_CYCLE", "0")
+        svc = self._service(cycle_time_s=0.4)
+        try:
+            time.sleep(0.05)  # the loop entered its fixed sleep
+            t0 = time.monotonic()
+            svc.negotiate("fixed_t", REQ_ALLREDUCE, shape=(4,))
+            took = time.monotonic() - t0
+            # must wait out the remainder of the fixed cycle (enqueue at
+            # ~0.05 into a 0.4 s sleep -> served no earlier than ~0.3 s)
+            assert took > 0.2, f"fixed cadence was not respected: {took}s"
+        finally:
+            svc.stop()
