@@ -6,10 +6,20 @@ TPU-native rebuild of the reference's ``ParameterManager``
 knob configuration by observed collective throughput (bytes/sec), explore
 the space, and settle on the best configuration. The reference drives the
 exploration with Bayesian optimization over a Gaussian-process posterior
-(``optim/bayesian_optimization.cc:1-194``); here a cyclic coordinate search
-over small discrete grids is used — the knob space is tiny (three knobs,
-<= 8 values each) and coordinate descent converges in a handful of samples
-without the GP machinery.
+(``optim/bayesian_optimization.cc:1-194``). Both strategies exist here,
+selected by ``HVD_AUTOTUNE_STRATEGY``:
+
+* ``coordinate`` (default) — cyclic coordinate search over the discrete
+  grids: the knob space is tiny (three knobs, <= 8 values each) and
+  coordinate descent converges in a handful of samples without the GP
+  machinery;
+* ``bayesian`` — the reference's GP + expected-improvement loop
+  (:mod:`horovod_tpu.optim.bayes`) over the same grids (proposals in
+  continuous index space, rounded), with
+  ``HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE`` as the GP noise ``alpha``;
+  converges when EI stays below threshold or the sample budget ends.
+  Worth it when the grid grows (more knobs / finer grids) and a full
+  coordinate pass becomes expensive in samples.
 
 Tuned knobs (the subset of the reference's set that has a consumer in the
 TPU rebuild; ``operations.cc:584-594``):
@@ -42,8 +52,11 @@ from __future__ import annotations
 
 import csv
 import json
+import math
 import threading
 import time
+
+import numpy as np
 
 from .utils import envs
 from .utils import logging as hvd_logging
@@ -54,6 +67,9 @@ MB = 1024 * 1024
 DEFAULT_WARMUP_SAMPLES = 3       # parameter_manager.h:42-110
 DEFAULT_STEPS_PER_SAMPLE = 10
 DEFAULT_MAX_SAMPLES = 40
+DEFAULT_GP_NOISE = 0.8           # reference HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE
+_EI_TOL = 1e-3                   # bayesian: converged when EI stays below
+_EI_PATIENCE = 2                 # ... for this many consecutive proposals
 
 
 class Tunable:
@@ -85,8 +101,59 @@ def _default_tunables() -> list[Tunable]:
     ]
 
 
+class _BayesianSearch:
+    """GP + expected-improvement proposals over the active tunables'
+    index space (reference ``BayesianOptimization`` driven by
+    ``ParameterManager::TuneParameters``). Proposals are continuous
+    index vectors rounded to the nearest grid point, so the decision
+    payload stays the same index-state the coordinate strategy and the
+    KV sync already speak."""
+
+    def __init__(self, active, seed: int = 0):
+        import itertools
+
+        from .optim.bayes import BayesianOptimization
+        self._bo = BayesianOptimization(
+            [(0.0, float(len(t.candidates) - 1)) for t in active],
+            alpha=envs.get_float(envs.AUTOTUNE_GAUSSIAN_PROCESS_NOISE,
+                                 DEFAULT_GP_NOISE),
+            seed=seed)
+        # EI is maximized over the exact knob grid: continuous proposals
+        # rounded to a coarse grid collapse onto the incumbent and never
+        # explore. Grids too large to enumerate get a fresh uniform sample
+        # of index combinations instead — a lexicographic prefix would
+        # silently bar every high-index value of the leading knobs.
+        sizes = [len(t.candidates) for t in active]
+        total = math.prod(sizes)
+        if total <= 4096:
+            self._grid = np.array(
+                list(itertools.product(*[range(s) for s in sizes])), float)
+        else:
+            rng = np.random.default_rng(seed)
+            self._grid = np.column_stack(
+                [rng.integers(0, s, size=4096) for s in sizes]).astype(float)
+        self._ei_low = 0
+
+    def propose(self, mgr: "ParameterManager", score: float) -> dict:
+        """Observe ``score`` for the CURRENT state, propose the next."""
+        active_idx = [mgr.tunables.index(t) for t in mgr._active]
+        self._bo.add_sample([float(mgr._state()[i]) for i in active_idx],
+                            score)
+        x_next, ei = self._bo.next_sample(candidates=self._grid)
+        if math.isfinite(ei) and len(self._bo._y) >= 5:
+            self._ei_low = self._ei_low + 1 if ei < _EI_TOL else 0
+            if self._ei_low >= _EI_PATIENCE:
+                return {"state": mgr._best_state, "converged": True}
+        next_state = list(mgr._best_state)
+        for pos, t, v in zip(active_idx, mgr._active, x_next):
+            next_state[pos] = int(np.clip(round(v),
+                                          0, len(t.candidates) - 1))
+        return {"state": next_state, "converged": False}
+
+
 class ParameterManager:
-    """Samples bytes/sec and coordinate-searches the knob grid."""
+    """Samples bytes/sec and searches the knob grid (coordinate descent
+    or the GP/EI loop, per ``HVD_AUTOTUNE_STRATEGY``)."""
 
     def __init__(self, tunables: list[Tunable] | None = None, *,
                  warmup_samples: int | None = None,
@@ -120,6 +187,17 @@ class ParameterManager:
         self._best_state = [t.index for t in self.tunables]
         self._pass_improved = False
         self.converged = not self._active
+        self.strategy = (envs.get(envs.AUTOTUNE_STRATEGY, "coordinate")
+                         or "coordinate").lower()
+        if self.strategy not in ("coordinate", "bayesian"):
+            hvd_logging.warning(
+                "unknown HVD_AUTOTUNE_STRATEGY %r; valid values are "
+                "'coordinate' and 'bayesian' — falling back to coordinate",
+                self.strategy)
+            self.strategy = "coordinate"
+        self._bayes = (_BayesianSearch(self._active)
+                       if self.strategy == "bayesian" and self._active
+                       else None)
         self._log_writer = None
         if self.log_path:
             f = open(self.log_path, "w", newline="")
@@ -172,13 +250,15 @@ class ParameterManager:
             self._finish(decision["state"])
 
     def _local_decision(self, score: float) -> dict:
-        """Advance the coordinate search by one scored sample."""
+        """Advance the search by one scored sample."""
         if self._best_score is None or score > self._best_score:
             self._best_score = score
             self._best_state = self._state()
             self._pass_improved = True
         if self._sample_idx - self.warmup_samples >= self.max_samples:
             return {"state": self._best_state, "converged": True}
+        if self._bayes is not None:
+            return self._bayes.propose(self, score)
         # move to the next candidate of the current coordinate, or the next
         # coordinate (restarting from the best state found so far)
         tun = self._active[self._coord]

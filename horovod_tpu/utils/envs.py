@@ -20,6 +20,7 @@ CACHE_CAPACITY = "CACHE_CAPACITY"  # reference default 1024 (global_state.h:89)
 TIMELINE = "TIMELINE"  # trace output path (operations.cc:466-488)
 TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"
 AUTOTUNE = "AUTOTUNE"
+AUTOTUNE_STRATEGY = "AUTOTUNE_STRATEGY"  # coordinate (default) | bayesian
 AUTOTUNE_LOG = "AUTOTUNE_LOG"
 AUTOTUNE_WARMUP_SAMPLES = "AUTOTUNE_WARMUP_SAMPLES"
 AUTOTUNE_STEPS_PER_SAMPLE = "AUTOTUNE_STEPS_PER_SAMPLE"

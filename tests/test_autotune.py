@@ -211,3 +211,65 @@ def test_kv_score_sync_protocol():
     assert seen["score"] == pytest.approx(2.0)
     out1 = s1(0, 3.0, lambda s: pytest.fail("follower must not decide"))
     assert out0 == out1 == {"state": [1], "converged": False}
+
+
+# -- Bayesian strategy (reference optim/bayesian_optimization.cc parity) ----
+
+
+def test_gp_regressor_interpolates_with_uncertainty():
+    from horovod_tpu.optim.bayes import GaussianProcessRegressor
+
+    X = np.array([[0.0], [0.25], [0.5], [0.75], [1.0]])
+    y = np.sin(X[:, 0] * np.pi)
+    gp = GaussianProcessRegressor(alpha=1e-6)
+    gp.fit(X, y)
+    mu, sd = gp.predict(X)
+    assert np.allclose(mu, y, atol=1e-2)      # near-interpolation
+    assert np.all(sd < 0.1)                    # low uncertainty at data
+    _, sd_far = gp.predict(np.array([[2.5]]))
+    assert sd_far[0] > sd.max()                # high uncertainty off-data
+
+
+def test_bayesian_optimization_finds_peak():
+    from horovod_tpu.optim.bayes import BayesianOptimization
+
+    def f(x):  # peak at 0.3
+        return -((x - 0.3) ** 2)
+
+    bo = BayesianOptimization([(0.0, 1.0)], alpha=1e-4, seed=1)
+    x = 0.9
+    for _ in range(12):
+        bo.add_sample([x], f(x))
+        x = float(bo.next_sample()[0][0])
+    best = bo._X[int(np.argmax(bo._y))][0]
+    assert abs(best - 0.3) < 0.12, best
+
+
+def test_bayesian_strategy_finds_best_config(monkeypatch):
+    monkeypatch.setenv("HVD_AUTOTUNE_STRATEGY", "bayesian")
+    tun = [Tunable("A", [1, 2, 4, 8]), Tunable("B", [0, 1])]
+
+    def score(cfg):  # peak at A=4, B=1
+        return 100 - abs(cfg["A"] - 4) * 10 + cfg["B"] * 5
+
+    mgr, run = make_manager(score, tun)
+    assert mgr.strategy == "bayesian"
+    run()
+    assert mgr.converged
+    assert mgr.current_config() == {"A": 4, "B": 1}
+
+
+def test_bayesian_strategy_respects_sample_budget(monkeypatch):
+    monkeypatch.setenv("HVD_AUTOTUNE_STRATEGY", "bayesian")
+    tun = [Tunable("A", list(range(8)))]
+    calls = []
+
+    def score(cfg):
+        calls.append(cfg["A"])
+        return float(cfg["A"])  # monotone: EI stays interesting
+
+    mgr, run = make_manager(score, tun, max_samples=10)
+    run()
+    assert mgr.converged
+    assert len(calls) <= 12  # budget + the convergence sample
+    assert mgr.current_config()["A"] == max(calls)
