@@ -14,7 +14,7 @@ Run (single host, virtual 8-chip mesh; each chip holds seq/8 tokens):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/long_context_lm.py
 
-Flags: --attn ring|ulysses, --seq-len, --smoke (tiny shapes, few steps).
+Flags: --attn ring|ring_zigzag|ulysses, --seq-len, --smoke (tiny shapes, few steps).
 """
 
 import argparse
@@ -42,7 +42,7 @@ def synthetic_tokens(n_seqs, seq_len, vocab, seed=0):
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--attn", choices=("ring", "ulysses"),
+    parser.add_argument("--attn", choices=("ring", "ring_zigzag", "ulysses"),
                         default="ring")
     parser.add_argument("--seq-len", type=int, default=None,
                         help="total context length (default 64 tokens/chip)")

@@ -38,7 +38,11 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--num-proc", type=int, default=2)
     parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI smoke: smallest useful run")
     args = parser.parse_args()
+    if args.smoke:
+        args.epochs = 2
 
     try:
         from pyspark.sql import SparkSession

@@ -33,9 +33,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     # long-context schedule: "full" (exact local attention), "ring"
     # (horovod_tpu.parallel.ring_attention — sequence sharded over
-    # seq_axis, KV blocks rotate over ICI), or "ulysses" (all-to-all
-    # seq<->head switch). ring/ulysses require the model to run inside
-    # shard_map with seq_axis bound and the sequence dimension sharded.
+    # seq_axis, KV blocks rotate over ICI), "ring_zigzag" (the ring with
+    # the causal load-balanced zigzag chunk schedule — the 2x causal
+    # saving lands in wall-clock, not just FLOPs), or "ulysses"
+    # (all-to-all seq<->head switch). All but "full" require the model to
+    # run inside shard_map with seq_axis bound and the sequence sharded.
     attn_mode: str = "full"
     seq_axis: str = "sp"
     # expert parallelism: moe_experts > 0 replaces the dense MLP with an
@@ -64,9 +66,13 @@ class Attention(nn.Module):
         q = dense("q", (cfg.num_heads, head_dim))(x)
         k = dense("k", (cfg.num_heads, head_dim))(x)
         v = dense("v", (cfg.num_heads, head_dim))(x)
-        if cfg.attn_mode == "ring" and not self.is_initializing():
+        if (cfg.attn_mode in ("ring", "ring_zigzag")
+                and not self.is_initializing()):
             from ..parallel import ring_attention
-            out = ring_attention(q, k, v, cfg.seq_axis, causal=True)
+            out = ring_attention(
+                q, k, v, cfg.seq_axis, causal=True,
+                schedule="zigzag" if cfg.attn_mode == "ring_zigzag"
+                else "contiguous")
         elif cfg.attn_mode == "ulysses" and not self.is_initializing():
             from ..parallel import ulysses_attention
             out = ulysses_attention(q, k, v, cfg.seq_axis, causal=True)
@@ -183,7 +189,8 @@ class TransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed")(tokens)
         positions = jnp.arange(tokens.shape[1])
-        if cfg.attn_mode in ("ring", "ulysses") and not self.is_initializing():
+        if (cfg.attn_mode in ("ring", "ring_zigzag", "ulysses")
+                and not self.is_initializing()):
             # sequence-parallel: this shard holds a block of the global
             # sequence — positions are offset by the block index
             positions = positions + jax.lax.axis_index(

@@ -173,9 +173,209 @@ def _ring_core_bwd(axis, causal, use_pallas, interpret, res, cts):
 _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
+# --------------------------------------------------------------------------
+# zigzag schedule: causal load balance.
+#
+# With contiguous blocks, the fully_future skip halves causal FLOPs but
+# not wall-clock: at ring step s only ranks r >= s have work, yet every
+# step still waits on a full block attend somewhere (rank n-1 works at
+# EVERY step). The zigzag assignment (Liu et al.'s ring + the zigzag
+# chunking used by zigzag ring/striped attention) splits the sequence
+# into 2n chunks and hands rank r chunks (r, 2n-1-r); at every step every
+# rank then does ~2 of its 4 (q-chunk, kv-chunk) sub-blocks — the causal
+# 2x shows up in latency, not just energy.
+# --------------------------------------------------------------------------
+
+
+def _zig_rank_of(chunk: int, n: int) -> int:
+    """Which rank owns global chunk id ``chunk`` in zigzag layout."""
+    return chunk if chunk < n else 2 * n - 1 - chunk
+
+
+def zigzag_shard(x, axis):
+    """Convert a contiguous shard_map sequence block (dim 1) to the zigzag
+    layout: rank r's (low, high) halves become global chunks (r, 2n-1-r).
+    Two half-block ppermutes; inverse is :func:`zigzag_unshard`."""
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    c = x.shape[1] // 2
+    # rank r holds contiguous chunks (2r, 2r+1); route each to its owner
+    perm_even = [(r, _zig_rank_of(2 * r, n)) for r in range(n)]
+    perm_odd = [(r, _zig_rank_of(2 * r + 1, n)) for r in range(n)]
+    recv_even = lax.ppermute(x[:, :c], axis, perm_even)   # even chunk ids
+    recv_odd = lax.ppermute(x[:, c:], axis, perm_odd)     # odd chunk ids
+    # my low chunk id is `my` (parity of `my` says which ppermute brought
+    # it); my high chunk id 2n-1-my has the opposite parity
+    even_is_low = (my % 2 == 0)
+    low = jnp.where(even_is_low, recv_even, recv_odd)
+    high = jnp.where(even_is_low, recv_odd, recv_even)
+    return jnp.concatenate([low, high], axis=1)
+
+
+def zigzag_unshard(x, axis):
+    """Inverse of :func:`zigzag_shard`."""
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    c = x.shape[1] // 2
+    low, high = x[:, :c], x[:, c:]
+    # my even-id chunk is `my` (low) when my is even, else 2n-1-my (high)
+    even_is_low = (my % 2 == 0)
+    payload_even = jnp.where(even_is_low, low, high)
+    payload_odd = jnp.where(even_is_low, high, low)
+    perm_even = [(_zig_rank_of(2 * r, n), r) for r in range(n)]
+    perm_odd = [(_zig_rank_of(2 * r + 1, n), r) for r in range(n)]
+    first = lax.ppermute(payload_even, axis, perm_even)   # chunk 2r
+    second = lax.ppermute(payload_odd, axis, perm_odd)    # chunk 2r+1
+    return jnp.concatenate([first, second], axis=1)
+
+
+def _zig_halves(block, c):
+    return block[:, :c], block[:, c:]
+
+
+def _zig_positions(qi, ki, my, kv_rank, n, c):
+    """Global token offsets of this rank's q-half ``qi`` and the arriving
+    block's kv-half ``ki`` (chunk ids: low = rank, high = 2n-1-rank);
+    ``qi``/``ki`` are Python ints, ``my``/``kv_rank`` traced scalars."""
+    q_chunk = my if qi == 0 else 2 * n - 1 - my
+    kv_chunk = kv_rank if ki == 0 else 2 * n - 1 - kv_rank
+    return ((q_chunk * c).astype(jnp.int32),
+            (kv_chunk * c).astype(jnp.int32))
+
+
+def _zig_attend_step(qf, k_cur, v_cur, carries, my, kv_rank, n, use_pallas,
+                     interpret):
+    """One zigzag ring step: 4 (q-half, kv-half) causal sub-attends, each
+    skipped entirely when the kv chunk is in the q chunk's future."""
+    from ..ops import flash
+
+    c = qf.shape[1] // 2
+    q_halves = _zig_halves(qf, c)
+    k_halves = _zig_halves(k_cur, c)
+    v_halves = _zig_halves(v_cur, c)
+    out = list(carries)
+    for qi in range(2):
+        for ki in range(2):
+            m, l, acc = out[qi]
+            qh, kh, vh = q_halves[qi], k_halves[ki], v_halves[ki]
+            qpos0, kpos0 = _zig_positions(qi, ki, my, kv_rank, n, c)
+
+            def attend(carry, _k=kh, _v=vh, _qp=qpos0, _kp=kpos0, _q=qh):
+                m, l, acc = carry
+                if use_pallas or interpret:
+                    return flash.block_attend(_q, _k, _v, _qp, _kp, True,
+                                              interpret, m, l, acc)
+                return flash._attend_jnp(_q, _k, _v, _qp, _kp, True,
+                                         m, l, acc)
+
+            fully_future = kpos0 > qpos0 + (c - 1)
+            out[qi] = lax.cond(fully_future, lambda cr: cr, attend,
+                               (m, l, acc))
+    return out
+
+
+def _zigzag_fwd_loop(qf, kf, vf, axis, use_pallas, interpret):
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    bh, sq, d = qf.shape
+    c = sq // 2
+
+    carries = [(jnp.full((bh, c, 1), NEG_INF, jnp.float32),
+                jnp.zeros((bh, c, 1), jnp.float32),
+                jnp.zeros((bh, c, d), jnp.float32)) for _ in range(2)]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = kf, vf
+    for step in range(n):
+        kv_rank = (my - step) % n
+        carries = _zig_attend_step(qf, k_cur, v_cur, carries, my, kv_rank,
+                                   n, use_pallas, interpret)
+        if step != n - 1:
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+    outs, lses = [], []
+    for m, l, acc in carries:
+        l_safe = jnp.maximum(l, 1e-30)
+        outs.append(acc / l_safe)
+        lses.append(m + jnp.log(l_safe))
+    return (jnp.concatenate(outs, axis=1), jnp.concatenate(lses, axis=1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _zigzag_core(qf, kf, vf, axis, use_pallas, interpret):
+    """Differentiable zigzag ring core (causal only), O(block) residuals
+    like :func:`_ring_core`."""
+    return _zigzag_fwd_loop(qf, kf, vf, axis, use_pallas, interpret)
+
+
+def _zigzag_core_fwd(qf, kf, vf, axis, use_pallas, interpret):
+    out, lse = _zigzag_fwd_loop(qf, kf, vf, axis, use_pallas, interpret)
+    return (out, lse), (qf, kf, vf, out, lse)
+
+
+def _zigzag_core_bwd(axis, use_pallas, interpret, res, cts):
+    """Re-rotating recompute backward over zigzag sub-blocks: dK/dV
+    accumulators rotate with their blocks, dQ halves accumulate locally
+    (mirrors :func:`_ring_core_bwd`)."""
+    from ..ops import flash
+
+    qf, kf, vf, out, lse = res
+    dout, _dlse = cts
+    dout = dout.astype(jnp.float32)
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    bh, sq, d = qf.shape
+    c = sq // 2
+    D = jnp.sum(dout * out, axis=-1, keepdims=True)
+
+    dq = jnp.zeros((bh, sq, d), jnp.float32)
+    dk_acc = jnp.zeros((bh, sq, d), jnp.float32)
+    dv_acc = jnp.zeros((bh, sq, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = kf, vf
+    for step in range(n):
+        kv_rank = (my - step) % n
+        for qi in range(2):
+            for ki in range(2):
+                qs = slice(qi * c, (qi + 1) * c)
+                ks = slice(ki * c, (ki + 1) * c)
+                qpos0, kpos0 = _zig_positions(qi, ki, my, kv_rank, n, c)
+
+                def grads(carry, _qs=qs, _ks=ks, _qp=qpos0, _kp=kpos0,
+                          _k=k_cur, _v=v_cur):
+                    dq, dk_a, dv_a = carry
+                    fn = (flash.flash_block_grads
+                          if (use_pallas or interpret)
+                          else flash.jnp_block_grads)
+                    kwargs = ({"interpret": interpret}
+                              if (use_pallas or interpret) else {})
+                    dq_b, dk_b, dv_b = fn(
+                        qf[:, _qs], _k[:, _ks], _v[:, _ks], lse[:, _qs],
+                        dout[:, _qs], D[:, _qs], _qp, _kp, True, **kwargs)
+                    return (dq.at[:, _qs].add(dq_b),
+                            dk_a.at[:, _ks].add(dk_b),
+                            dv_a.at[:, _ks].add(dv_b))
+
+                fully_future = kpos0 > qpos0 + (c - 1)
+                dq, dk_acc, dv_acc = lax.cond(
+                    fully_future, lambda cr: cr, grads, (dq, dk_acc, dv_acc))
+        # dK/dV travel WITH their block; the extra nth rotation returns
+        # every accumulator home
+        dk_acc = lax.ppermute(dk_acc, axis, perm)
+        dv_acc = lax.ppermute(dv_acc, axis, perm)
+        if step != n - 1:
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+    return (dq.astype(qf.dtype), dk_acc.astype(kf.dtype),
+            dv_acc.astype(vf.dtype))
+
+
+_zigzag_core.defvjp(_zigzag_core_fwd, _zigzag_core_bwd)
+
+
 def ring_attention(q, k, v, axis, *, causal: bool = True,
                    use_pallas: bool | None = None,
-                   interpret: bool = False):
+                   interpret: bool = False,
+                   schedule: str = "contiguous"):
     """Blockwise ring attention over mesh axis ``axis``.
 
     Inside ``shard_map`` with the sequence dimension sharded over
@@ -191,6 +391,15 @@ def ring_attention(q, k, v, axis, *, causal: bool = True,
     Differentiating through this saves O(block) residuals (re-rotating
     recompute backward, :func:`_ring_core_bwd`), so per-chip training
     memory stays flat as the ring grows.
+
+    ``schedule="zigzag"`` (causal only, even per-chip block length)
+    rebalances causal work: the contiguous layout's fully-future skip
+    halves FLOPs but not wall-clock (the last rank works at every step);
+    zigzag hands each rank chunks (r, 2n-1-r) so every step does ~half a
+    block everywhere and the 2x lands in latency. Inputs/outputs keep the
+    contiguous layout — conversion costs eight half-block ppermutes per
+    call (two each for q/k/v in, two for the output back), amortized
+    over the n ring steps.
     """
     from ..ops import flash
 
@@ -204,8 +413,26 @@ def ring_attention(q, k, v, axis, *, causal: bool = True,
     qf = (q * scale).transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out, _lse = _ring_core(qf, kf, vf, axis, causal, bool(use_pallas),
-                           bool(interpret))
+    if schedule == "zigzag":
+        if not causal:
+            raise ValueError("schedule='zigzag' is a causal load-balance; "
+                             "use the contiguous schedule for non-causal")
+        if sq != sk or sq % 2:
+            raise ValueError(
+                f"zigzag needs equal, even per-chip q/kv block lengths; "
+                f"got sq={sq}, sk={sk}")
+        qf = zigzag_shard(qf, axis)
+        kf = zigzag_shard(kf, axis)
+        vf = zigzag_shard(vf, axis)
+        out, _lse = _zigzag_core(qf, kf, vf, axis, bool(use_pallas),
+                                 bool(interpret))
+        out = zigzag_unshard(out, axis)
+    elif schedule == "contiguous":
+        out, _lse = _ring_core(qf, kf, vf, axis, causal, bool(use_pallas),
+                               bool(interpret))
+    else:
+        raise ValueError(f"unknown ring schedule {schedule!r}; valid: "
+                         "'contiguous', 'zigzag'")
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3).astype(v.dtype)
 
 

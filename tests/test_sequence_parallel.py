@@ -158,7 +158,7 @@ def test_ulysses_rejects_indivisible_heads():
             check_vma=False))(x)
 
 
-@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("mode", ["ring", "ring_zigzag", "ulysses"])
 def test_transformer_lm_sequence_parallel_matches_full(mode):
     """TransformerLM(attn_mode=ring/ulysses) under shard_map over the
     sequence axis produces the same logits as full attention on the whole
@@ -537,3 +537,109 @@ def test_ulysses_residuals_are_o_sequence_constant():
     assert len(leaves) == 5
     for leaf in leaves:
         assert np.prod(leaf.shape) <= bh * s * d, leaf.shape  # never s^2
+
+
+# -- zigzag schedule (causal load balance) ----------------------------------
+
+
+def test_zigzag_shard_roundtrip():
+    """zigzag_shard places rank r's halves at global chunks (r, 2n-1-r);
+    unshard is its exact inverse."""
+    from horovod_tpu.parallel.sequence import zigzag_shard, zigzag_unshard
+
+    n = hvd.size()
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    seq = 2 * n * 3  # chunk size 3
+    x = np.arange(seq, dtype=np.float32).reshape(1, seq, 1)
+
+    zz = jax.jit(jax.shard_map(lambda t: zigzag_shard(t, axis), mesh=mesh,
+                               in_specs=P(None, axis),
+                               out_specs=P(None, axis), check_vma=False))
+    back = jax.jit(jax.shard_map(lambda t: zigzag_unshard(t, axis),
+                                 mesh=mesh, in_specs=P(None, axis),
+                                 out_specs=P(None, axis), check_vma=False))
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, axis)))
+    z = zz(xs)
+    # rank r's local block must be [chunk r, chunk 2n-1-r]
+    zh = np.asarray(z).reshape(n, 2, 3)  # gathered: rank-major halves
+    c = 3
+    for r in range(n):
+        assert np.allclose(zh[r, 0], np.arange(r * c, (r + 1) * c)), r
+        hi = 2 * n - 1 - r
+        assert np.allclose(zh[r, 1], np.arange(hi * c, (hi + 1) * c)), r
+    assert np.allclose(np.asarray(back(z)), x)
+
+
+def test_zigzag_ring_matches_full():
+    n = hvd.size()
+    q, k, v = make_qkv(4 * n, seed=11)
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    sharding = NamedSharding(mesh, P(None, axis))
+    sharded = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis, causal=True,
+                                       schedule="zigzag"),
+        mesh=mesh, in_specs=(P(None, axis),) * 3,
+        out_specs=P(None, axis), check_vma=False))
+    out = np.asarray(sharded(*[jax.device_put(t, sharding)
+                               for t in (q, k, v)]))
+    expect = reference_attention(q, k, v, True)
+    assert np.allclose(out, expect, rtol=2e-4, atol=2e-5), \
+        np.abs(out - expect).max()
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_zigzag_ring_gradients_match(use_pallas):
+    """Zigzag gradients equal full-attention gradients through both the
+    jnp and the Pallas (interpret) block-gradient paths."""
+    n = hvd.size()
+    q, k, v = make_qkv(2 * n, seed=12)
+    tgt = np.random.default_rng(13).standard_normal(q.shape).astype(
+        np.float32)
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    sharding = NamedSharding(mesh, P(None, axis))
+
+    def ring_loss(q, k, v, t):
+        out = ring_attention(q, k, v, axis, causal=True, schedule="zigzag",
+                             use_pallas=use_pallas, interpret=use_pallas)
+        return jnp.sum((out - t) ** 2)
+
+    grad_fn = jax.jit(jax.shard_map(
+        lambda q, k, v, t: jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v,
+                                                                  t),
+        mesh=mesh, in_specs=(P(None, axis),) * 4,
+        out_specs=(P(None, axis),) * 3, check_vma=False))
+    gq, gk, gv = [np.asarray(g) for g in grad_fn(
+        *[jax.device_put(t, sharding) for t in (q, k, v, tgt)])]
+
+    def full_loss(q, k, v):
+        scale = 1.0 / jnp.sqrt(D)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum((out - tgt) ** 2)
+
+    eq, ek, ev = jax.grad(full_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert np.allclose(gq, eq, rtol=1e-3, atol=1e-4), np.abs(gq - eq).max()
+    assert np.allclose(gk, ek, rtol=1e-3, atol=1e-4), np.abs(gk - ek).max()
+    assert np.allclose(gv, ev, rtol=1e-3, atol=1e-4), np.abs(gv - ev).max()
+
+
+def test_zigzag_rejects_bad_configs():
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    q, k, v = make_qkv(2 * hvd.size())
+    with pytest.raises(ValueError, match="causal"):
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis, causal=False,
+                                           schedule="zigzag"),
+            mesh=mesh, in_specs=(P(None, axis),) * 3,
+            out_specs=P(None, axis), check_vma=False)(q, k, v)
+    with pytest.raises(ValueError, match="unknown ring schedule"):
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis,
+                                           schedule="spiral"),
+            mesh=mesh, in_specs=(P(None, axis),) * 3,
+            out_specs=P(None, axis), check_vma=False)(q, k, v)
