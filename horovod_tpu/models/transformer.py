@@ -51,6 +51,14 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
 
+    def __post_init__(self):
+        # An unknown mode would silently fall through to full LOCAL
+        # attention per shard — training runs, logits are wrong.
+        valid = ("full", "ring", "ring_zigzag", "ulysses")
+        if self.attn_mode not in valid:
+            raise ValueError(
+                f"unknown attn_mode {self.attn_mode!r}; valid: {valid}")
+
 
 class Attention(nn.Module):
     cfg: TransformerConfig

@@ -643,3 +643,12 @@ def test_zigzag_rejects_bad_configs():
                                            schedule="spiral"),
             mesh=mesh, in_specs=(P(None, axis),) * 3,
             out_specs=P(None, axis), check_vma=False)(q, k, v)
+
+
+def test_transformer_config_rejects_unknown_attn_mode():
+    """A typo'd mode must fail at config time — the dispatch would
+    otherwise silently run full LOCAL attention per shard."""
+    from horovod_tpu.models import TransformerConfig
+
+    with pytest.raises(ValueError, match="unknown attn_mode"):
+        TransformerConfig(attn_mode="zigzag")
