@@ -22,6 +22,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+# THE valid attention schedules — single source of truth for the config
+# validator and both dispatch sites (Attention + position offsets).
+ATTN_MODES = ("full", "ring", "ring_zigzag", "ulysses")
+SEQ_PARALLEL_MODES = ("ring", "ring_zigzag", "ulysses")
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -54,10 +60,10 @@ class TransformerConfig:
     def __post_init__(self):
         # An unknown mode would silently fall through to full LOCAL
         # attention per shard — training runs, logits are wrong.
-        valid = ("full", "ring", "ring_zigzag", "ulysses")
-        if self.attn_mode not in valid:
+        if self.attn_mode not in ATTN_MODES:
             raise ValueError(
-                f"unknown attn_mode {self.attn_mode!r}; valid: {valid}")
+                f"unknown attn_mode {self.attn_mode!r}; valid: "
+                f"{ATTN_MODES}")
 
 
 class Attention(nn.Module):
@@ -197,7 +203,7 @@ class TransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed")(tokens)
         positions = jnp.arange(tokens.shape[1])
-        if (cfg.attn_mode in ("ring", "ring_zigzag", "ulysses")
+        if (cfg.attn_mode in SEQ_PARALLEL_MODES
                 and not self.is_initializing()):
             # sequence-parallel: this shard holds a block of the global
             # sequence — positions are offset by the block index

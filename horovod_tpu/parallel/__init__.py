@@ -16,9 +16,18 @@ package makes the schedules themselves first-class for TPU:
 * :func:`moe_alltoall` (+ :func:`route_top_k`, :func:`load_balance_loss`)
   — expert parallelism: capacity-bounded top-k MoE dispatch/combine over
   one alltoall each way, one expert group per chip.
+* :func:`pipeline_apply` — GPipe-style pipeline parallelism: one stage's
+  params per chip, microbatches flowing around a ``ppermute`` ring inside
+  one ``lax.scan`` (no host scheduler), optional stage rematerialization.
 """
 
 from .moe import load_balance_loss, moe_alltoall, route_top_k
+from .pipeline import (
+    microbatch,
+    pipeline_apply,
+    stack_stage_params,
+    unstack_stage,
+)
 from .sequence import (
     heads_to_seq,
     ring_attention,
@@ -27,5 +36,7 @@ from .sequence import (
 )
 
 __all__ = ["ring_attention", "ulysses_attention", "seq_to_heads",
-           "heads_to_seq", "moe_alltoall", "route_top_k",
+           "heads_to_seq", "pipeline_apply", "microbatch",
+           "stack_stage_params", "unstack_stage",
+           "moe_alltoall", "route_top_k",
            "load_balance_loss"]

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: a GPipe-style microbatch schedule over a mesh axis.
+
+The reference framework is pure data-parallel — pipeline parallelism has
+no analog there (SURVEY §2 maps tp/sp/ep; pp is beyond-parity). On TPU
+the natural formulation is SPMD: every rank holds ONE stage's parameters,
+microbatches flow around a ``ppermute`` ring, and the whole schedule is a
+``lax.scan`` the compiler can pipeline — no per-stage processes, no
+host-side scheduler (contrast torch's GPipe/PipeDream runtimes).
+
+    out = pipeline_apply(stage_fn, stage_params, x, "pp",
+                         n_microbatches=8)
+
+``stage_fn(params, x) -> y`` is the per-stage computation with ``y``
+shaped like ``x`` (the transformer-block invariant: d_model in, d_model
+out); rank r applies it as stage r. The returned global output (every
+microbatch, last stage's values) is broadcast to all pipeline ranks with
+one ``psum``, so a loss computed after it is identical everywhere and
+gradients flow back through the schedule's AD transpose (``ppermute``
+reverses direction, the scan transposes into the reverse sweep).
+
+Memory: the scan saves one activation per tick per stage by default —
+O((n_micro + n_stages) · microbatch). ``remat=True`` wraps the stage in
+``jax.checkpoint`` so only stage BOUNDARIES persist and the backward
+recomputes block internals, the standard trade for deep stages.
+
+Composition: the pp axis is one axis of the device mesh; data parallelism
+(dp) shards the batch over another axis outside this function, tensor
+parallelism (tp) shards ``stage_fn``'s internals — see
+``__graft_entry__.dryrun_multichip`` for a dp x pp x tp training step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _broadcast_from_last(done, axis):
+    """Replicate the last pipeline rank's values to every rank (one
+    psum of a masked buffer). Custom VJP because the raw psum's transpose
+    SUMS the cotangents of the n identical replicas — a loss computed
+    from the replicated output on every rank (the normal shard_map
+    pattern with ``check_vma=False``) would see axis-size-times-too-large
+    gradients; averaging the replica cotangents restores the one-loss
+    semantics exactly."""
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    return lax.psum(jnp.where(my == n - 1, done, jnp.zeros_like(done)),
+                    axis)
+
+
+def _broadcast_from_last_fwd(done, axis):
+    return _broadcast_from_last(done, axis), None
+
+
+def _broadcast_from_last_bwd(axis, _res, ct):
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    return (jnp.where(my == n - 1, lax.pmean(ct, axis),
+                      jnp.zeros_like(ct)),)
+
+
+_broadcast_from_last.defvjp(_broadcast_from_last_fwd,
+                            _broadcast_from_last_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _replicated_input(x, axis):
+    """Identity on a pp-replicated input whose VJP replicates the
+    cotangent too: the raw schedule's transpose lands d(loss)/dx on pp
+    rank 0 only (only rank 0 feeds the ring), which would silently shrink
+    (after a pmean) or desync (without one) gradients of any shared
+    layers upstream of the pipeline. psum-ing the rank-0-only cotangent
+    hands every pp rank the identical full dx, so upstream replicated
+    params get replica-consistent gradients with no collective needed."""
+    return x
+
+
+def _replicated_input_fwd(x, axis):
+    return x, None
+
+
+def _replicated_input_bwd(axis, _res, ct):
+    return (lax.psum(ct, axis),)
+
+
+_replicated_input.defvjp(_replicated_input_fwd, _replicated_input_bwd)
+
+
+def microbatch(x, n_microbatches: int):
+    """(B, ...) -> (n_micro, B/n_micro, ...); validates divisibility."""
+    if x.shape[0] % n_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} must divide into n_microbatches="
+            f"{n_microbatches}")
+    return x.reshape(n_microbatches, x.shape[0] // n_microbatches,
+                     *x.shape[1:])
+
+
+def pipeline_apply(stage_fn, params, x, axis, *, n_microbatches: int,
+                   remat: bool = False):
+    """Run the GPipe schedule inside ``shard_map`` with ``axis`` bound.
+
+    Args:
+      stage_fn: ``(params, x_microbatch) -> y_microbatch``, same shape.
+      params: THIS rank's stage parameters (stage r on rank r).
+      x: the full (global-batch, ...) input block, identical on every
+        pipeline rank (shard it over a separate dp axis for data
+        parallelism).
+      axis: bound mesh axis name; its size is the number of stages.
+      n_microbatches: pipeline depth of the schedule; the bubble fraction
+        is (stages-1)/(n_micro + stages - 1), so use n_micro >= stages.
+      remat: rematerialize stage internals in the backward.
+
+    Returns the (global-batch, ...) output of the LAST stage, broadcast
+    to every pipeline rank (one ``psum``).
+
+    Gradient conventions (both replica-consistent, no user collectives
+    needed over the pp axis): d(loss)/d(stage params) carries exactly-once
+    one-loss semantics (see :func:`_broadcast_from_last`), and
+    d(loss)/dx is the identical full input cotangent on EVERY pp rank
+    (see :func:`_replicated_input`) — shared layers upstream of the
+    pipeline train correctly whether or not their grads are pmean'd
+    over pp.
+    """
+    n = int(lax.psum(1, axis))
+    my = lax.axis_index(axis)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    x = _replicated_input(x, axis)  # replica-consistent d(loss)/dx
+    micro = microbatch(x, n_microbatches)
+    mb_shape = micro.shape[1:]
+    total = n_microbatches + n - 1  # fill + drain ticks
+    pad = jnp.zeros((n - 1,) + mb_shape, x.dtype)
+    stream = jnp.concatenate([micro, pad], axis=0)  # rank 0's feed
+
+    # one hop toward the next stage; the last stage's send wraps to rank 0
+    # where it is ignored (rank 0 feeds from the stream)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(buf, feed):
+        stage_in = jnp.where(my == 0, feed, buf)
+        out = fn(params, stage_in)
+        return lax.ppermute(out, axis, perm), out
+
+    buf0 = jnp.zeros(mb_shape, x.dtype)
+    _, outs = lax.scan(tick, buf0, stream)  # outs: (total, mb, ...)
+
+    # microbatch m leaves the last stage at tick m + n - 1
+    done = outs[n - 1:].reshape((x.shape[0],) + mb_shape[1:])
+    # broadcast the last stage's outputs to every pipeline rank so the
+    # loss (and its gradient source) is identical everywhere
+    return _broadcast_from_last(done, axis)
+
+
+def stack_stage_params(per_stage_params):
+    """Host-side helper: a list of per-stage pytrees -> one pytree with a
+    leading stage dim, ready to shard with ``P('pp')`` so shard_map hands
+    rank r stage r's slice (squeeze the leading 1 inside with
+    :func:`unstack_stage`)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def unstack_stage(stacked):
+    """Inside shard_map: drop the leading per-rank stage dim of 1."""
+    return jax.tree.map(lambda x: jnp.squeeze(x, 0), stacked)
