@@ -23,9 +23,10 @@ from jax.sharding import PartitionSpec as P
 
 
 # THE valid attention schedules — single source of truth for the config
-# validator and both dispatch sites (Attention + position offsets).
-ATTN_MODES = ("full", "ring", "ring_zigzag", "ulysses")
-SEQ_PARALLEL_MODES = ("ring", "ring_zigzag", "ulysses")
+# validator, the Attention dispatch, and the position-offset check.
+RING_SCHEDULES = {"ring": "contiguous", "ring_zigzag": "zigzag"}
+SEQ_PARALLEL_MODES = tuple(RING_SCHEDULES) + ("ulysses",)
+ATTN_MODES = ("full",) + SEQ_PARALLEL_MODES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,13 +81,10 @@ class Attention(nn.Module):
         q = dense("q", (cfg.num_heads, head_dim))(x)
         k = dense("k", (cfg.num_heads, head_dim))(x)
         v = dense("v", (cfg.num_heads, head_dim))(x)
-        if (cfg.attn_mode in ("ring", "ring_zigzag")
-                and not self.is_initializing()):
+        if cfg.attn_mode in RING_SCHEDULES and not self.is_initializing():
             from ..parallel import ring_attention
-            out = ring_attention(
-                q, k, v, cfg.seq_axis, causal=True,
-                schedule="zigzag" if cfg.attn_mode == "ring_zigzag"
-                else "contiguous")
+            out = ring_attention(q, k, v, cfg.seq_axis, causal=True,
+                                 schedule=RING_SCHEDULES[cfg.attn_mode])
         elif cfg.attn_mode == "ulysses" and not self.is_initializing():
             from ..parallel import ulysses_attention
             out = ulysses_attention(q, k, v, cfg.seq_axis, causal=True)

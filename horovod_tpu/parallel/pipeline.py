@@ -24,9 +24,10 @@ O((n_micro + n_stages) · microbatch). ``remat=True`` wraps the stage in
 recomputes block internals, the standard trade for deep stages.
 
 Composition: the pp axis is one axis of the device mesh; data parallelism
-(dp) shards the batch over another axis outside this function, tensor
-parallelism (tp) shards ``stage_fn``'s internals — see
-``__graft_entry__.dryrun_multichip`` for a dp x pp x tp training step.
+(dp) shards the batch over another axis outside this function, and tensor
+parallelism (tp) would shard ``stage_fn``'s internals over yet another —
+see ``__graft_entry__.dryrun_multichip`` for a dp x pp training step and
+``examples/pipeline_train.py`` for a full pipelined LM.
 """
 
 from __future__ import annotations
@@ -106,7 +107,9 @@ def pipeline_apply(stage_fn, params, x, axis, *, n_microbatches: int,
     """Run the GPipe schedule inside ``shard_map`` with ``axis`` bound.
 
     Args:
-      stage_fn: ``(params, x_microbatch) -> y_microbatch``, same shape.
+      stage_fn: ``(params, x_microbatch) -> y_microbatch``, same shape;
+        the output is cast back to ``x.dtype`` (stages may compute in
+        higher precision internally).
       params: THIS rank's stage parameters (stage r on rank r).
       x: the full (global-batch, ...) input block, identical on every
         pipeline rank (shard it over a separate dp axis for data
@@ -144,7 +147,10 @@ def pipeline_apply(stage_fn, params, x, axis, *, n_microbatches: int,
 
     def tick(buf, feed):
         stage_in = jnp.where(my == 0, feed, buf)
-        out = fn(params, stage_in)
+        # cast back to the stream dtype: a stage computing in higher
+        # precision (f32 params on bf16 activations) would otherwise
+        # break the scan carry with an opaque dtype-mismatch error
+        out = fn(params, stage_in).astype(x.dtype)
         return lax.ppermute(out, axis, perm), out
 
     buf0 = jnp.zeros(mb_shape, x.dtype)
