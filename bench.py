@@ -185,6 +185,11 @@ def main():
                              "window; the chain serializes the steps)")
     parser.add_argument("--fp32", action="store_true",
                         help="compute in float32 instead of bfloat16")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize the forward in the backward "
+                             "(jax.checkpoint): trades ~30%% more FLOPs "
+                             "for activation memory, enabling per-chip "
+                             "batches past HBM (e.g. 512 on v5e)")
     parser.add_argument("--max-wait", type=float, default=1200.0,
                         help="max seconds to wait for the accelerator "
                              "backend to answer a clean-exit probe before "
@@ -223,9 +228,12 @@ def main():
 
     def train_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
-            logits, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images, train=True,
+            apply = lambda p, x: model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
                 mutable=["batch_stats"])
+            if args.remat:
+                apply = jax.checkpoint(apply)
+            logits, mutated = apply(p, images)
             one_hot = jax.nn.one_hot(labels, 1000)
             loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), -1))
             return loss, mutated["batch_stats"]

@@ -48,7 +48,11 @@ def main():
 
     hvd.init()
     n = hvd.size()
-    pp = args.pp if n % args.pp == 0 else 1
+    if args.pp < 1 or n % args.pp:
+        raise SystemExit(
+            f"--pp {args.pp} must be a positive divisor of the "
+            f"{n}-device world")
+    pp = args.pp
     dp = n // pp
     mesh = Mesh(np.array(jax.devices()[:n]).reshape(dp, pp), ("dp", "pp"))
 
