@@ -19,10 +19,10 @@ fail=0
 
 step() { echo; echo "=== $* ==="; }
 
-step "0/5 native build from source (no committed binaries)"
+step "0/6 native build from source (no committed binaries)"
 python -c "from horovod_tpu._native import build_native; print(build_native(force=True))"
 
-step "1/5 test suite (tests/, virtual 8-device mesh via conftest)"
+step "1/6 test suite (tests/, virtual 8-device mesh via conftest)"
 python -m pytest tests/ -q -x
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -33,10 +33,10 @@ if [[ "${1:-}" == "--fast" ]]; then
   exit 0
 fi
 
-step "1b/5 test suite, second pass (flake detection)"
+step "1b/6 test suite, second pass (flake detection)"
 python -m pytest tests/ -q -x
 
-step "2/5 driver artifact: single-chip compile check (entry)"
+step "2/6 driver artifact: single-chip compile check (entry)"
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -46,10 +46,10 @@ jax.jit(fn).lower(*args).compile()
 print("entry() compile OK")
 EOF
 
-step "3/5 driver artifact: multi-chip dryrun (8 virtual devices)"
+step "3/6 driver artifact: multi-chip dryrun (8 virtual devices)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
 
-step "4/5 example smoke runs (single-process 8-dev mesh + np=2 hvdrun, like gen-pipeline.sh:160-290)"
+step "4/6 example smoke runs (single-process 8-dev mesh + np=2 hvdrun, like gen-pipeline.sh:160-290)"
 for ex in examples/*.py; do
   echo "--- $ex (1 process, 8 virtual devices)"
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -58,5 +58,15 @@ done
 echo "--- examples/mnist.py (hvdrun -np 2)"
 env -u XLA_FLAGS python -m horovod_tpu.runner.launch -np 2 -- \
   python examples/mnist.py --smoke || fail=1
+
+step "5/6 eager negotiation microbench (np=2, sanity: both paths work)"
+env -u XLA_FLAGS python eager_bench.py --iters 40 --warmup 5 | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+assert d['adaptive_cycle']['ops_per_sec'] > 0, d
+assert d['fixed_cycle']['ops_per_sec'] > 0, d
+print('eager negotiation OK:', d['adaptive_cycle']['ms_per_negotiation'],
+      'ms/negotiation adaptive vs', d['fixed_cycle']['ms_per_negotiation'],
+      'fixed')" || fail=1
 
 exit $fail
