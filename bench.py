@@ -289,6 +289,10 @@ def main():
         flops_per_step_per_chip = (
             ANALYTIC_RESNET50_TRAIN_FLOPS_PER_IMAGE * args.batch_size)
         flops_source = "analytic_model_flops_remat_excluded"
+    elif args.remat:
+        # analytic fallback under remat: we have no executed count at all
+        # (the analytic number is MODEL flops); don't mislabel it
+        flops_executed = None
     else:
         flops_executed = flops_per_step_per_chip
 

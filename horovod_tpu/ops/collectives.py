@@ -53,6 +53,7 @@ from ..dynamic import (
 from ..process_sets import ProcessSet, _resolve
 from . import hierarchical
 from .reduce_ops import ReduceOp, handle_average
+from ..utils import envs
 from ..utils import logging as hvd_logging
 
 
@@ -770,6 +771,11 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
 
     if _axis_is_bound(axis):
         groups = pset.axis_index_groups()
+        traced_fusion = envs.get_int(envs.TRACED_FUSION_THRESHOLD, 0)
+        if len(tensors) > 1 and traced_fusion > 0:
+            return _grouped_allreduce_traced_fused(
+                tensors, axis, op, prescale_factor, postscale_factor,
+                groups, traced_fusion)
         return [_allreduce_traced(t, axis, op, prescale_factor,
                                   postscale_factor, groups)
                 for t in tensors]
@@ -793,6 +799,57 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
         return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
                                         float(prescale_factor), float(post),
                                         len(tensors))
+
+
+def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
+                                    limit):
+    """OPT-IN explicit tensor fusion on the TRACED path
+    (``HVD_TRACED_FUSION_THRESHOLD`` > 0, bytes per fused buffer): pack
+    same-dtype leaves into bounded flat buffers, ONE collective per
+    buffer (every reduce op is elementwise, so fusing is exact) — the
+    traced twin of the eager fusion buffer (reference
+    ``fusion_buffer_manager.h:30-50``).
+
+    OFF by default and deliberately so: inside one program the compiler's
+    all-reduce combiner + latency-hiding scheduler interleave per-leaf
+    collectives WITH the backward compute, while an explicit fused buffer
+    serializes all communication after all compute — measured on the
+    virtual-CPU scaling harness, a 96 MB fused buffer took Inception's
+    n=8 collective efficiency from ~0.90 to 0.26. The knob exists for
+    backends without a combiner pass and for experimentation."""
+    out: list = [None] * len(tensors)
+    by_dtype: dict = {}
+    for i, t in enumerate(tensors):
+        by_dtype.setdefault(jnp.result_type(t), []).append(i)
+
+    def flush(chunk):
+        if not chunk:
+            return
+        if len(chunk) == 1:  # nothing to fuse; skip the reshape round trip
+            j = chunk[0]
+            out[j] = _allreduce_traced(tensors[j], axis, op, pre, post,
+                                       groups)
+            return
+        fused = jnp.concatenate([jnp.ravel(tensors[j]) for j in chunk])
+        red = _allreduce_traced(fused, axis, op, pre, post, groups)
+        off = 0
+        for j in chunk:
+            size = tensors[j].size
+            out[j] = red[off:off + size].reshape(jnp.shape(tensors[j]))
+            off += size
+
+    for dt, idxs in by_dtype.items():
+        chunk: list = []
+        chunk_bytes = 0
+        for j in idxs:
+            nbytes = tensors[j].size * dt.itemsize
+            if chunk and chunk_bytes + nbytes > limit:
+                flush(chunk)
+                chunk, chunk_bytes = [], 0
+            chunk.append(j)
+            chunk_bytes += nbytes
+        flush(chunk)
+    return out
 
 
 def _execute_grouped_bundles(bundles, pset, axis, lowered_op, pre, post,

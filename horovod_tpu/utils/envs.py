@@ -15,6 +15,7 @@ import os
 
 # --- knob names (HVD_*; HOROVOD_* accepted as fallback) -------------------
 FUSION_THRESHOLD = "FUSION_THRESHOLD"  # bytes; reference default 128 MB (operations.cc:491-496)
+TRACED_FUSION_THRESHOLD = "TRACED_FUSION_THRESHOLD"  # bytes; 0 (default) = let XLA's combiner fuse traced collectives
 CYCLE_TIME = "CYCLE_TIME"  # ms; reference default 1 ms (operations.cc:499-506)
 CACHE_CAPACITY = "CACHE_CAPACITY"  # reference default 1024 (global_state.h:89)
 TIMELINE = "TIMELINE"  # trace output path (operations.cc:466-488)

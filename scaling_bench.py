@@ -142,7 +142,7 @@ def _build_mode(mode: str, n: int, model, side, total_batch):
 
 
 def child_main(n: int, modes: list, total_batch: int, iters: int,
-               model_name: str = "resnet", rounds: int = 5) -> None:
+               model_name: str = "resnet", rounds: int | None = None) -> None:
     """Measure ALL modes interleaved in ONE process: round-robin timing
     windows so machine-load drift hits every mode equally, then paired
     per-round ratios. Round-4's separate-child design produced impossible
@@ -153,6 +153,10 @@ def child_main(n: int, modes: list, total_batch: int, iters: int,
 
     import horovod_tpu as hvd
 
+    if rounds is None:
+        # variance lives at ROUND granularity (drift between adjacent
+        # windows), so reps buy precision as rounds, not window length
+        rounds = int(os.environ.get("SCALING_ROUNDS", "5"))
     hvd.init()  # collective layer resolves the (global) process set
     model, side, _desc = _make_model(model_name)
     built = {m: _build_mode(m, n, model, side, total_batch) for m in modes}

@@ -309,3 +309,40 @@ def test_sparse_allreduce_async(hvd):
     dense = np.asarray(hvd.rows_to_dense(out))
     np.testing.assert_allclose(dense[0], N * 1.0)
     np.testing.assert_allclose(dense[1], 0.0)
+
+
+@pytest.mark.parametrize("op_name", ["Average", "Sum", "Max"])
+def test_grouped_allreduce_traced_fusion_exact(hvd, monkeypatch, op_name):
+    """The traced fusion buffer (pack same-dtype leaves, ONE collective
+    per HVD_TRACED_FUSION_THRESHOLD-bounded chunk) must be numerically
+    identical to per-leaf collectives, across chunk boundaries, mixed
+    shapes and dtypes, and every elementwise reduce op."""
+    op = getattr(hvd, op_name)
+    rng = np.random.default_rng(5)
+    # mixed shapes/dtypes; threshold 64 bytes forces multiple f32 chunks
+    leaves = [
+        jnp.asarray(rng.standard_normal((N, 3)), jnp.float32),
+        jnp.asarray(rng.standard_normal((N,)), jnp.float32),
+        jnp.asarray(rng.standard_normal((N, 2, 2)), jnp.float32),
+        # a genuinely distinct dtype group (x64 is off, float64 would
+        # silently truncate to float32 and never split the groups)
+        jnp.asarray(rng.standard_normal((N, 5)), jnp.bfloat16),
+    ]
+    monkeypatch.setenv("HVD_TRACED_FUSION_THRESHOLD", "64")
+
+    def step(*vs):
+        return tuple(hvd.grouped_allreduce(list(vs), op=op))
+
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(axis),) * len(leaves),
+        out_specs=(P(axis),) * len(leaves), check_vma=False))
+    fused = [np.asarray(o) for o in fn(*leaves)]
+
+    monkeypatch.setenv("HVD_TRACED_FUSION_THRESHOLD", "0")  # per-leaf
+    fn2 = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(axis),) * len(leaves),
+        out_specs=(P(axis),) * len(leaves), check_vma=False))
+    unfused = [np.asarray(o) for o in fn2(*leaves)]
+    for f, u in zip(fused, unfused):
+        np.testing.assert_allclose(f, u, rtol=1e-6)
