@@ -190,10 +190,12 @@ def main():
                              "(jax.checkpoint): trades ~30%% more FLOPs "
                              "for activation memory, enabling per-chip "
                              "batches past HBM (e.g. 512 on v5e)")
-    parser.add_argument("--max-wait", type=float, default=1200.0,
+    parser.add_argument("--max-wait", type=float, default=600.0,
                         help="max seconds to wait for the accelerator "
                              "backend to answer a clean-exit probe before "
-                             "proceeding anyway (0 disables the wait)")
+                             "giving up with an error artifact (0 disables "
+                             "the wait; kept under typical driver kill "
+                             "budgets so the artifact always lands)")
     args = parser.parse_args()
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
@@ -361,7 +363,27 @@ def main():
     }))
 
 
+def _error_artifact(message: str) -> None:
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": message[:500],
+    }), flush=True)
+
+
+def _on_sigterm(signum, frame):
+    # A supervising driver's kill budget must not erase the evidence:
+    # emit the parseable error artifact before dying (SIGKILL is
+    # unsurvivable, but drivers normally TERM first).
+    _error_artifact(f"terminated by signal {signum} while running/waiting")
+    sys.exit(1)
+
+
 if __name__ == "__main__":
+    import signal
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         main()
     except Exception as e:  # noqa: BLE001 — the artifact must always parse
@@ -369,11 +391,5 @@ if __name__ == "__main__":
         # what failed (round 4's rc=1 with empty stdout lost the evidence).
         import traceback
         traceback.print_exc()
-        print(json.dumps({
-            "metric": "resnet50_synthetic_images_per_sec_per_chip",
-            "value": None,
-            "unit": "images/sec/chip",
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}"[:500],
-        }))
+        _error_artifact(f"{type(e).__name__}: {e}")
         sys.exit(1)  # the artifact parses, but the run did fail

@@ -436,6 +436,30 @@ def _eager_grouped_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
         check_vma=False))
 
 
+def _fusion_buckets(tensors, threshold: int, elem_count):
+    """THE fusion bucketing rule, shared by the eager wire buffers and the
+    opt-in traced fusion: group indices by dtype, then split each group
+    into buckets whose total bytes stay <= ``threshold`` (a single
+    oversized tensor gets its own bucket). ``elem_count(t)`` gives the
+    per-rank element count of one tensor. Yields (dtype, [indices])."""
+    by_dtype: dict = {}
+    for i, t in enumerate(tensors):
+        by_dtype.setdefault(jnp.result_type(t), []).append(i)
+    for dt, idxs in by_dtype.items():
+        itemsize = jnp.dtype(dt).itemsize
+        bucket: list = []
+        bucket_bytes = 0
+        for i in idxs:
+            nbytes = elem_count(tensors[i]) * itemsize
+            if bucket and bucket_bytes + nbytes > threshold:
+                yield dt, bucket
+                bucket, bucket_bytes = [], 0
+            bucket.append(i)
+            bucket_bytes += nbytes
+        if bucket:
+            yield dt, bucket
+
+
 def _fuse_by_dtype(bundles: list, n: int):
     """Pack (n, ...) bundles into flat (n, total) wire buffers per dtype
     (the XLA analog of the reference's fusion buffer,
@@ -443,31 +467,13 @@ def _fuse_by_dtype(bundles: list, n: int):
     threshold (``HVD_FUSION_THRESHOLD``; reference default 128 MB,
     ``operations.cc:491-496`` — the autotuner tunes this knob at runtime).
     Returns (fused_inputs, metas)."""
-    from ..utils import envs as _envs
-    threshold = _envs.fusion_threshold_bytes()
-    by_dtype: dict = {}
-    for i, b in enumerate(bundles):
-        by_dtype.setdefault(jnp.result_type(b), []).append(i)
     fused_inputs, metas = [], []
-    for dt, idxs in by_dtype.items():
-        itemsize = jnp.dtype(dt).itemsize
-        bucket: list = []
-        bucket_bytes = 0
-        buckets = [bucket]
-        for i in idxs:
-            nbytes = int(np.prod(bundles[i].shape[1:]) or 1) * itemsize
-            if bucket and bucket_bytes + nbytes > threshold:
-                bucket = []
-                bucket_bytes = 0
-                buckets.append(bucket)
-            bucket.append(i)
-            bucket_bytes += nbytes
-        for bidxs in buckets:
-            if not bidxs:
-                continue
-            flat = [bundles[i].reshape(n, -1) for i in bidxs]
-            fused_inputs.append(jnp.concatenate(flat, axis=1))
-            metas.append((dt, bidxs, [bundles[i].shape[1:] for i in bidxs]))
+    for dt, bidxs in _fusion_buckets(
+            bundles, envs.fusion_threshold_bytes(),
+            lambda b: int(np.prod(b.shape[1:]) or 1)):
+        flat = [bundles[i].reshape(n, -1) for i in bidxs]
+        fused_inputs.append(jnp.concatenate(flat, axis=1))
+        metas.append((dt, bidxs, [bundles[i].shape[1:] for i in bidxs]))
     return fused_inputs, metas
 
 
@@ -818,18 +824,13 @@ def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
     n=8 collective efficiency from ~0.90 to 0.26. The knob exists for
     backends without a combiner pass and for experimentation."""
     out: list = [None] * len(tensors)
-    by_dtype: dict = {}
-    for i, t in enumerate(tensors):
-        by_dtype.setdefault(jnp.result_type(t), []).append(i)
-
-    def flush(chunk):
-        if not chunk:
-            return
+    for _dt, chunk in _fusion_buckets(tensors, limit,
+                                      lambda t: int(t.size)):
         if len(chunk) == 1:  # nothing to fuse; skip the reshape round trip
             j = chunk[0]
             out[j] = _allreduce_traced(tensors[j], axis, op, pre, post,
                                        groups)
-            return
+            continue
         fused = jnp.concatenate([jnp.ravel(tensors[j]) for j in chunk])
         red = _allreduce_traced(fused, axis, op, pre, post, groups)
         off = 0
@@ -837,18 +838,6 @@ def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
             size = tensors[j].size
             out[j] = red[off:off + size].reshape(jnp.shape(tensors[j]))
             off += size
-
-    for dt, idxs in by_dtype.items():
-        chunk: list = []
-        chunk_bytes = 0
-        for j in idxs:
-            nbytes = tensors[j].size * dt.itemsize
-            if chunk and chunk_bytes + nbytes > limit:
-                flush(chunk)
-                chunk, chunk_bytes = [], 0
-            chunk.append(j)
-            chunk_bytes += nbytes
-        flush(chunk)
     return out
 
 
