@@ -18,6 +18,7 @@ timeline — is built on demand from ``native/`` and bound via ctypes
 timeline (``hvd.start_timeline``).
 """
 
+from .utils import compat as _compat  # installs the jax.shard_map shim
 from . import runtime as _runtime
 from .runtime import (
     AXIS_NAME,
@@ -76,6 +77,7 @@ from .ops import (
     broadcast,
     broadcast_async,
     broadcast_object,
+    dispatch_cache_stats,
     grouped_allreduce,
     grouped_allreduce_async,
     grouped_broadcast,
@@ -147,7 +149,9 @@ __all__ = [
     "Product", "ReduceOp", "Sum", "adasum_allreduce", "allgather",
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
-    "broadcast_", "broadcast_async", "broadcast_object", "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
+    "broadcast_", "broadcast_async", "broadcast_object",
+    "dispatch_cache_stats",
+    "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",

@@ -487,3 +487,7 @@ def reset_service() -> None:
     # replacement workers start at 0, desynchronizing negotiation names.
     from .ops import collectives as _coll
     _coll._auto_counters.clear()
+    # Dispatch plans pin their negotiation decision (service object + the
+    # stable tensor names) — all stale after a service teardown.
+    from .ops import dispatch_cache
+    dispatch_cache.invalidate("engine service reset")

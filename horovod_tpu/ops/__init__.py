@@ -23,6 +23,8 @@ from .collectives import (
     reducescatter,
     synchronize,
 )
+from .dispatch_cache import reset as reset_dispatch_cache
+from .dispatch_cache import stats as dispatch_cache_stats
 from .adasum import adasum_allreduce
 from .hierarchical import (
     hierarchical_allgather,
@@ -45,6 +47,7 @@ __all__ = [
     "alltoall_async", "barrier", "broadcast", "broadcast_async",
     "broadcast_object", "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast", "join", "per_rank", "poll",
     "reducescatter", "synchronize", "adasum_allreduce",
+    "dispatch_cache_stats", "reset_dispatch_cache",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",
     "sparse_allreduce_to_dense",

@@ -51,8 +51,10 @@ from ..dynamic import (
     REQ_REDUCESCATTER,
 )
 from ..process_sets import ProcessSet, _resolve
+from . import dispatch_cache as _dispatch
 from . import hierarchical
 from .reduce_ops import ReduceOp, handle_average
+from ..utils import compat as _compat
 from ..utils import envs
 from ..utils import logging as hvd_logging
 
@@ -391,49 +393,98 @@ def _reducescatter_traced(x, axis, op, post, groups):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _eager_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float, post: float):
-    def inner(x):  # x: (1, ...) bundle shard
-        return _allreduce_traced(x, axis, op, pre, post, None)
+def _eager_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
+                        post: float, bundled: bool = True,
+                        row0: bool = False):
+    """``bundled``: x is a (n, ...) per-rank bundle, one row per chip.
+    Replicated (``bundled=False``): x is the raw array every rank
+    contributes identically — ``in_specs=P()`` lets shard_map replicate it
+    without the ``broadcast_to`` + device transfer a bundle would cost.
+    ``row0`` (dispatch plans): return the replicated result row directly
+    (``out_specs=P()``) so the caller needs no eager ``[0]`` slice — a
+    cross-device gather — per call."""
+    def inner(x):
+        out = _allreduce_traced(x, axis, op, pre, post, None)
+        return out[0] if (bundled and row0) else out
+    in_spec = P(axis) if bundled else P()
+    out_spec = P() if (row0 or not bundled) else P(axis)
     return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+        inner, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_vma=False))
+
+
+def _grouped_allreduce_smap(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
+                            post: float, num_bufs: int, bundled: bool):
+    """Raw shard-mapped fused reduction (not jitted) — composed into the
+    jitted wire programs below and into dispatch-plan programs that fold
+    the wire-buffer split into the same compiled call."""
+    def inner(*xs):
+        return tuple(_allreduce_traced(x, axis, op, pre, post, None) for x in xs)
+    spec = P(axis) if bundled else P()
+    specs = tuple(spec for _ in range(num_bufs))
+    return jax.shard_map(inner, mesh=mesh, in_specs=specs, out_specs=specs,
+                         check_vma=False)
 
 
 @functools.lru_cache(maxsize=None)
 def _eager_grouped_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
-                                post: float, num_bufs: int):
+                                post: float, num_bufs: int,
+                                bundled: bool = True,
+                                donate: tuple = ()):
+    """Fused wire-buffer program. ``donate`` marks which fused inputs are
+    dispatcher-owned temporaries (never user arrays) — those buffers are
+    donated so the reduction reuses their HBM instead of holding input and
+    output live simultaneously."""
+    return jax.jit(
+        _grouped_allreduce_smap(mesh, axis, op, pre, post, num_bufs, bundled),
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_allgather_fn(mesh: Mesh, axis: str, bundled: bool = True):
+    if bundled:
+        def inner(x):  # (1, d0, ...) -> (n*d0, ...) replicated
+            return lax.all_gather(x[0], axis, tiled=True)
+        in_spec = P(axis)
+    else:
+        def inner(x):  # replicated (d0, ...) -> (n*d0, ...)
+            return lax.all_gather(x, axis, tiled=True)
+        in_spec = P()
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=in_spec, out_specs=P(), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
+                        bundled: bool = True):
+    def inner(x):  # -> (...) replicated
+        return _broadcast_traced(x[0] if bundled else x, axis, root_pos,
+                                 None, None)
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis) if bundled else P(),
+        out_specs=P(), check_vma=False))
+
+
+def _grouped_broadcast_smap(mesh: Mesh, axis: str, root_pos: int,
+                            num_bufs: int, bundled: bool):
     def inner(*xs):
-        return tuple(_allreduce_traced(x, axis, op, pre, post, None) for x in xs)
-    specs = tuple(P(axis) for _ in range(num_bufs))
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=specs, out_specs=specs, check_vma=False))
-
-
-@functools.lru_cache(maxsize=None)
-def _eager_allgather_fn(mesh: Mesh, axis: str):
-    def inner(x):  # (1, d0, ...) -> (n*d0, ...) replicated
-        return lax.all_gather(x[0], axis, tiled=True)
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False))
-
-
-@functools.lru_cache(maxsize=None)
-def _eager_broadcast_fn(mesh: Mesh, axis: str, root_pos: int):
-    def inner(x):  # (1, ...) -> (...) replicated
-        return _broadcast_traced(x[0], axis, root_pos, None, None)
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False))
+        return tuple(_broadcast_traced(x[0] if bundled else x, axis,
+                                       root_pos, None, None)
+                     for x in xs)
+    spec = P(axis) if bundled else P()
+    specs = tuple(spec for _ in range(num_bufs))
+    return jax.shard_map(inner, mesh=mesh, in_specs=specs,
+                         out_specs=tuple(P() for _ in specs),
+                         check_vma=False)
 
 
 @functools.lru_cache(maxsize=None)
 def _eager_grouped_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
-                                num_bufs: int):
-    def inner(*xs):
-        return tuple(_broadcast_traced(x[0], axis, root_pos, None, None)
-                     for x in xs)
-    specs = tuple(P(axis) for _ in range(num_bufs))
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=specs, out_specs=tuple(P() for _ in specs),
-        check_vma=False))
+                                num_bufs: int, bundled: bool = True,
+                                donate: tuple = ()):
+    return jax.jit(
+        _grouped_broadcast_smap(mesh, axis, root_pos, num_bufs, bundled),
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
 
 
 def _fusion_buckets(tensors, threshold: int, elem_count):
@@ -696,6 +747,334 @@ def _negotiate_eager_group(kind: str, request_type: int, name: str | None,
 
 
 # ---------------------------------------------------------------------------
+# dispatch plans: steady-state eager fast path (see ops/dispatch_cache.py)
+# ---------------------------------------------------------------------------
+
+def _plan_sig(t):
+    """Cache-key signature of one eager input: ("b", bundle shape, dtype)
+    for uniform PerRank bundles, ("r", shape, dtype) for raw arrays every
+    rank contributes identically. None = not plan-cacheable (ragged
+    bundles, python scalars/lists — those keep the generic path)."""
+    if isinstance(t, PerRank):
+        if t.dim0s is not None:
+            return None
+        a = t.array
+        return ("b", tuple(a.shape), jnp.dtype(a.dtype).name)
+    shape = getattr(t, "shape", None)
+    dtype = getattr(t, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        return ("r", tuple(shape), jnp.dtype(dtype).name)
+    except TypeError:
+        return None
+
+
+def _plan_negotiation(kind: str, request_type: int, name: str | None,
+                      shape, dtype, pset: ProcessSet, **meta):
+    """Pinned negotiation decision for a plan: None when no service applies
+    (the per-call ``get_service`` + auto-name round is skipped on every
+    hit), else a closure re-negotiating the SAME tensor name with the same
+    precomputed metadata — which the native engine serves from its response
+    cache via the bitvector AND (the reference ``ComputeResponseList`` HIT
+    path) instead of a full metadata exchange."""
+    from .. import engine_service
+    svc = engine_service.get_service(pset)
+    if svc is None:
+        return None
+    neg_name = name or _auto_name(kind, pset)
+    dt = jnp.dtype(dtype)
+    kwargs = dict(dtype=_dtype_id(dt), element_size=dt.itemsize,
+                  shape=tuple(int(d) for d in shape), **meta)
+
+    def negotiate():
+        resp = svc.negotiate(neg_name, request_type, **kwargs)
+        if resp is not None and resp.from_cache:
+            _dispatch.note_negotiation_skip()
+        return resp
+
+    return negotiate
+
+
+def _plan_group_negotiation(kind: str, request_type: int, name: str | None,
+                            shapes_dtypes, pset: ProcessSet, **meta):
+    """Grouped twin of :func:`_plan_negotiation`: the request batch is
+    assembled once and replayed with stable names on every hit."""
+    import zlib
+    from .. import engine_service
+    svc = engine_service.get_service(pset)
+    if svc is None:
+        return None
+    base = name or _auto_name(kind, pset)
+    gid = zlib.crc32(base.encode()) & 0x7FFFFFFF
+    reqs = []
+    for i, (shape, dtype) in enumerate(shapes_dtypes):
+        dt = jnp.dtype(dtype)
+        reqs.append(dict(name=f"{base}.{i}", request_type=request_type,
+                         dtype=_dtype_id(dt), element_size=dt.itemsize,
+                         shape=tuple(int(d) for d in shape), group_id=gid,
+                         **meta))
+
+    def negotiate():
+        resps = svc.negotiate_many(reqs)
+        if resps and all(r.from_cache for r in resps):
+            _dispatch.note_negotiation_skip()
+        return resps
+
+    return negotiate
+
+
+def _bundle_of(t, shape, n: int):
+    """Per-call canonicalization for the bundle strategy: PerRank arrays
+    pass through; raw arrays are expanded to the (n, ...) bundle (only the
+    mixed PerRank+raw grouped case still pays this — all-raw groups use the
+    replicated strategy with no expansion at all)."""
+    if isinstance(t, PerRank):
+        return t.array
+    return jnp.broadcast_to(jnp.asarray(t)[None], (n,) + shape)
+
+
+def _grouped_donate_mask(metas, alias_risk) -> tuple:
+    """Which fused wire buffers are safe to donate. A fused buffer is a
+    dispatcher-owned temporary (concatenate/reshape output) EXCEPT when its
+    bucket has a single member whose flatten is a no-op — jnp's reshape and
+    single-array concatenate fast paths then hand back the caller's own
+    array object, which must never be donated. ``alias_risk(i)`` says
+    whether member ``i``'s flatten can no-op onto a user-held array."""
+    return tuple(
+        not (len(bidxs) == 1 and alias_risk(bidxs[0]))
+        for (_dt, bidxs, _shapes) in metas)
+
+
+def _fuse_flat(tensors):
+    """Replicated-strategy fusion: pack raw same-dtype arrays into flat
+    wire vectors (no leading rank axis — every rank contributes the same
+    values, so the program replicates via ``in_specs=P()``)."""
+    fused, metas = [], []
+    for dt, bidxs in _fusion_buckets(tensors, envs.fusion_threshold_bytes(),
+                                     lambda t: max(int(t.size), 1)):
+        flat = [tensors[i].reshape(-1) for i in bidxs]
+        fused.append(jnp.concatenate(flat) if len(flat) > 1 else flat[0])
+        metas.append((dt, bidxs, [tuple(tensors[i].shape) for i in bidxs]))
+    return fused, metas
+
+
+def _build_allreduce_plan(sig, pset: ProcessSet, axis, op: ReduceOp,
+                          pre_f: float, post_f: float, name: str | None):
+    lowered_op, post = handle_average(op, pset.size(), post_f)
+    pre, post = float(pre_f), float(post)
+    bundled = sig[0] == "b"
+    per_shape = sig[1][1:] if bundled else sig[1]
+    dtype = jnp.dtype(sig[2])
+    if (lowered_op == ReduceOp.SUM
+            and hierarchical.hierarchical_enabled_for(pset)):
+        fn = hierarchical._eager_hier_allreduce_fn(
+            hierarchical.hierarchical_mesh(), lowered_op, pre, post,
+            bundled, row0=bundled)
+    else:
+        fn = _eager_allreduce_fn(pset.mesh(), axis, lowered_op, pre, post,
+                                 bundled, row0=bundled)
+    if bundled:
+        def execute(t):  # row0 program: replicated result, no eager slice
+            return fn(t.array)
+    else:
+        def execute(t):
+            return fn(jnp.asarray(t))
+    negotiate = _plan_negotiation(
+        "allreduce", REQ_ALLREDUCE, name, per_shape, dtype, pset,
+        reduce_op=int(lowered_op), prescale=pre, postscale=post)
+    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
+    return _dispatch.DispatchPlan(name or "allreduce", "ALLREDUCE", nbytes,
+                                  negotiate, execute)
+
+
+def _plan_fused_programs(metas, smap, n: int, count: int, bundled: bool,
+                         donate: tuple, row0: bool):
+    """The plan's two compiled stages. Stage 1 (``fuse``) canonicalizes
+    user tensors into the per-dtype wire buffers in ONE program (the eager
+    reshape+concatenate op storm this replaces dominated steady-state
+    dispatch). Stage 2 (``wire``) runs the shard-mapped collective AND the
+    wire-buffer split in one program, with the wire buffers donated —
+    they are stage-1 outputs, so donation can only recycle
+    dispatcher-owned memory (``donate`` additionally excludes buffers a
+    backend's input-output forwarding could alias to a user array:
+    identity-reshape single-tensor buckets)."""
+    if bundled:
+        def fuse(*bundles):
+            return tuple(jnp.concatenate([bundles[i].reshape(n, -1)
+                                          for i in bidxs], axis=1)
+                         for (_dt, bidxs, _s) in metas)
+
+        def wire(*fused):
+            outs = smap(*fused)
+            if row0:
+                outs = [o[0] for o in outs]
+            return tuple(_split_fused(list(outs), metas, count))
+    else:
+        def fuse(*arrs):
+            return tuple(jnp.concatenate([arrs[i].reshape(-1)
+                                          for i in bidxs])
+                         if len(bidxs) > 1 else arrs[bidxs[0]].reshape(-1)
+                         for (_dt, bidxs, _s) in metas)
+
+        def wire(*fused):
+            return tuple(_split_fused(list(smap(*fused)), metas, count))
+    fuse_fn = jax.jit(fuse)
+    wire_fn = jax.jit(
+        wire, donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+    return fuse_fn, wire_fn
+
+
+def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
+                                  op: ReduceOp, pre_f: float, post_f: float,
+                                  name: str | None):
+    lowered_op, post = handle_average(op, pset.size(), post_f)
+    pre, post = float(pre_f), float(post)
+    n = pset.size()
+    count = len(tensors)
+    bundled = any(s[0] == "b" for s in sigs)
+    shapes = [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
+    hier = (lowered_op == ReduceOp.SUM
+            and hierarchical.hierarchical_enabled_for(pset))
+    if bundled:
+        first = [_bundle_of(t, shp, n) for t, shp in zip(tensors, shapes)]
+        _, metas = _fuse_by_dtype(first, n)
+        donate = _grouped_donate_mask(
+            metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
+    else:
+        first = [jnp.asarray(t) for t in tensors]
+        _, metas = _fuse_flat(first)
+        donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
+    if hier:
+        smap = hierarchical._hier_grouped_allreduce_smap(
+            hierarchical.hierarchical_mesh(), lowered_op, pre, post,
+            len(metas), bundled)
+    else:
+        smap = _grouped_allreduce_smap(pset.mesh(), axis, lowered_op, pre,
+                                       post, len(metas), bundled)
+    fuse_fn, wire_fn = _plan_fused_programs(metas, smap, n, count, bundled,
+                                            donate, row0=bundled)
+    if bundled:
+        def execute(ts):
+            bundles = [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
+            return list(wire_fn(*fuse_fn(*bundles)))
+    else:
+        def execute(ts):
+            return list(wire_fn(*fuse_fn(*[jnp.asarray(t) for t in ts])))
+    negotiate = _plan_group_negotiation(
+        "grouped_allreduce", REQ_ALLREDUCE, name,
+        [(shp, jnp.dtype(s[2])) for shp, s in zip(shapes, sigs)], pset,
+        reduce_op=int(lowered_op), prescale=pre, postscale=post)
+    nbytes = sum(int(np.prod(shp) or 1) * jnp.dtype(s[2]).itemsize
+                 for shp, s in zip(shapes, sigs))
+    return _dispatch.DispatchPlan(name or "grouped_allreduce",
+                                  "GROUPED_ALLREDUCE", nbytes, negotiate,
+                                  execute)
+
+
+def _build_broadcast_plan(sig, pset: ProcessSet, axis, root_rank: int,
+                          name: str | None):
+    bundled = sig[0] == "b"
+    per_shape = sig[1][1:] if bundled else sig[1]
+    dtype = jnp.dtype(sig[2])
+    root_pos = pset.ranks.index(root_rank)
+    fn = _eager_broadcast_fn(pset.mesh(), axis, root_pos, bundled)
+    if bundled:
+        def execute(t):
+            return fn(t.array)
+    else:
+        def execute(t):
+            return fn(jnp.asarray(t))
+    negotiate = _plan_negotiation("broadcast", REQ_BROADCAST, name,
+                                  per_shape, dtype, pset,
+                                  root_rank=root_rank)
+    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
+    return _dispatch.DispatchPlan(name or "broadcast", "BROADCAST", nbytes,
+                                  negotiate, execute)
+
+
+def _build_grouped_broadcast_plan(tensors, sigs, pset: ProcessSet, axis,
+                                  root_rank: int, name: str | None):
+    n = pset.size()
+    count = len(tensors)
+    root_pos = pset.ranks.index(root_rank)
+    bundled = any(s[0] == "b" for s in sigs)
+    shapes = [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
+    if bundled:
+        first = [_bundle_of(t, shp, n) for t, shp in zip(tensors, shapes)]
+        _, metas = _fuse_by_dtype(first, n)
+        donate = _grouped_donate_mask(
+            metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
+    else:
+        first = [jnp.asarray(t) for t in tensors]
+        _, metas = _fuse_flat(first)
+        donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
+    smap = _grouped_broadcast_smap(pset.mesh(), axis, root_pos, len(metas),
+                                   bundled)
+    fuse_fn, wire_fn = _plan_fused_programs(metas, smap, n, count, bundled,
+                                            donate, row0=False)
+    if bundled:
+        def execute(ts):
+            bundles = [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
+            return list(wire_fn(*fuse_fn(*bundles)))
+    else:
+        def execute(ts):
+            return list(wire_fn(*fuse_fn(*[jnp.asarray(t) for t in ts])))
+    negotiate = _plan_group_negotiation(
+        "grouped_broadcast", REQ_BROADCAST, name,
+        [(shp, jnp.dtype(s[2])) for shp, s in zip(shapes, sigs)], pset,
+        root_rank=root_rank)
+    return _dispatch.DispatchPlan(name or "grouped_broadcast",
+                                  "GROUPED_BROADCAST", None, negotiate,
+                                  execute)
+
+
+def _build_allgather_plan(sig, pset: ProcessSet, axis, name: str | None):
+    """Uniform-shape eager allgather plan. Returns None when a negotiation
+    service runs — the engine's recv_splits can resize the program per
+    call (ragged peers / joined processes), so multi-process allgather
+    keeps the response-driven path."""
+    from .. import engine_service
+    if engine_service.get_service(pset) is not None:
+        return None
+    bundled = sig[0] == "b"
+    per_shape = sig[1][1:] if bundled else sig[1]
+    dtype = jnp.dtype(sig[2])
+    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
+    if len(per_shape) >= 1 and per_shape[0] == 0:
+        rest = per_shape[1:]
+
+        def execute(t):
+            # uniform zero-row gather: no data moves (XLA forbids the
+            # zero-size gather dim); result empty on every rank
+            return jnp.zeros((0,) + rest, dtype)
+        return _dispatch.DispatchPlan(name or "allgather", "ALLGATHER",
+                                      nbytes, None, execute)
+    if hierarchical.hierarchical_allgather_enabled_for(pset):
+        fn = hierarchical._eager_hier_allgather_fn(
+            hierarchical.hierarchical_mesh(), bundled)
+    else:
+        fn = _eager_allgather_fn(pset.mesh(), axis, bundled)
+    scalar = len(per_shape) == 0
+    if bundled:
+        if scalar:  # (n,) bundle of scalars -> (n,) vector
+            def execute(t):
+                return fn(t.array[:, None]).reshape(-1)
+        else:
+            def execute(t):
+                return fn(t.array)
+    else:
+        if scalar:
+            def execute(t):
+                return fn(jnp.asarray(t).reshape(1)).reshape(-1)
+        else:
+            def execute(t):
+                return fn(jnp.asarray(t))
+    return _dispatch.DispatchPlan(name or "allgather", "ALLGATHER", nbytes,
+                                  None, execute)
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
@@ -714,10 +1093,26 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_allreduce
         return adasum_allreduce(tensor, process_set=pset, axis_name=axis)
-    if _axis_is_bound(axis):
+    if _compat.trace_state_clean():
+        # definitely eager (no trace in progress): plan-cached dispatch.
+        # HVD_CACHE_CAPACITY=0 (the off switch) keeps the original
+        # build-everything-per-call path below.
+        sig = _plan_sig(tensor) if _dispatch.enabled() else None
+        if sig is not None:
+            key = ("allreduce", name, sig, axis, pset.dispatch_key(),
+                   int(op), float(prescale_factor), float(postscale_factor),
+                   hierarchical.hierarchical_enabled_for(pset))
+            plan = _dispatch.lookup(key)
+            if plan is None:
+                plan = _build_allreduce_plan(sig, pset, axis, op,
+                                             prescale_factor,
+                                             postscale_factor, name)
+                _dispatch.store(key, plan)
+            return plan.run(tensor)
+    elif _axis_is_bound(axis):
         return _allreduce_traced(tensor, axis, op, prescale_factor,
                                  postscale_factor, pset.axis_index_groups())
-    if _contains_tracer(tensor):
+    elif _contains_tracer(tensor):
         # Inside jit/pjit with no named axis: GSPMD semantics — gradients of
         # a globally-sharded computation are already globally reduced by
         # XLA's partitioner, so the allreduce is the identity (the design
@@ -727,6 +1122,8 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
         _gspmd_passthrough_check(op, "allreduce")
         scale = prescale_factor * postscale_factor
         return tensor if scale == 1.0 else tensor * scale
+    # non-plannable eager input (python scalars/lists, ragged misuse) or a
+    # jax build without the trace-state probe: generic bundle path
     lowered_op, post = handle_average(op, pset.size(), postscale_factor)
     bundle, _ = _as_bundle(tensor, pset)
     _negotiate_eager("allreduce", REQ_ALLREDUCE, name, bundle.shape[1:],
@@ -775,7 +1172,23 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
         from .adasum import adasum_allreduce
         return [adasum_allreduce(t, process_set=pset, axis_name=axis) for t in tensors]
 
-    if _axis_is_bound(axis):
+    if _compat.trace_state_clean():
+        sigs = (tuple(_plan_sig(t) for t in tensors)
+                if _dispatch.enabled() else (None,))
+        if all(s is not None for s in sigs):
+            key = ("grouped_allreduce", name, sigs, axis,
+                   pset.dispatch_key(), int(op), float(prescale_factor),
+                   float(postscale_factor),
+                   hierarchical.hierarchical_enabled_for(pset),
+                   envs.fusion_threshold_bytes())
+            plan = _dispatch.lookup(key)
+            if plan is None:
+                plan = _build_grouped_allreduce_plan(
+                    tensors, sigs, pset, axis, op, prescale_factor,
+                    postscale_factor, name)
+                _dispatch.store(key, plan)
+            return plan.run(tensors)
+    elif _axis_is_bound(axis):
         groups = pset.axis_index_groups()
         traced_fusion = envs.get_int(envs.TRACED_FUSION_THRESHOLD, 0)
         if len(tensors) > 1 and traced_fusion > 0:
@@ -785,7 +1198,7 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
         return [_allreduce_traced(t, axis, op, prescale_factor,
                                   postscale_factor, groups)
                 for t in tensors]
-    if any(_contains_tracer(t) for t in tensors):
+    elif any(_contains_tracer(t) for t in tensors):
         # GSPMD passthrough (see allreduce above).
         _gspmd_passthrough_check(op, "grouped_allreduce")
         scale = prescale_factor * postscale_factor
@@ -847,6 +1260,10 @@ def _execute_grouped_bundles(bundles, pset, axis, lowered_op, pre, post,
     shared by the caller path and the joined-rank zero path."""
     n = pset.size()
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
+    # No donation here: this generic path doubles as the HVD_CACHE_CAPACITY=0
+    # reference behavior; buffer donation lives in the dispatch plans' wire
+    # programs (_plan_fused_programs), where the wire buffers are provably
+    # dispatcher-owned stage-1 outputs.
     if (lowered_op == ReduceOp.SUM
             and hierarchical.hierarchical_enabled_for(pset)):
         fn = hierarchical._eager_hier_grouped_allreduce_fn(
@@ -876,10 +1293,22 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
     """
     pset = _resolve(process_set)
     axis = _resolve_axis(axis_name)
-    if _axis_is_bound(axis):
+    if _compat.trace_state_clean():
+        sig = _plan_sig(tensor) if _dispatch.enabled() else None
+        if sig is not None:
+            key = ("allgather", name, sig, axis, pset.dispatch_key(),
+                   hierarchical.hierarchical_allgather_enabled_for(pset))
+            plan = _dispatch.lookup(key)
+            if plan is None:
+                plan = (_build_allgather_plan(sig, pset, axis, name)
+                        or _dispatch.UNPLANNABLE)
+                _dispatch.store(key, plan)
+            if plan is not _dispatch.UNPLANNABLE:
+                return plan.run(tensor)
+    elif _axis_is_bound(axis):
         return _allgather_traced(tensor, axis, pset.axis_index_groups(),
                                  pset.ranks, pset.size())
-    if _contains_tracer(tensor):
+    elif _contains_tracer(tensor):
         raise RuntimeError(
             "allgather() was called inside jit/pjit without a bound mesh axis. "
             "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
@@ -989,10 +1418,21 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
     axis = _resolve_axis(axis_name)
     if root_rank not in pset.ranks:
         raise ValueError(f"root_rank {root_rank} not in process set {pset.ranks}")
-    if _axis_is_bound(axis):
+    if _compat.trace_state_clean():
+        sig = _plan_sig(tensor) if _dispatch.enabled() else None
+        if sig is not None:
+            key = ("broadcast", name, sig, axis, pset.dispatch_key(),
+                   root_rank)
+            plan = _dispatch.lookup(key)
+            if plan is None:
+                plan = _build_broadcast_plan(sig, pset, axis, root_rank,
+                                             name)
+                _dispatch.store(key, plan)
+            return plan.run(tensor)
+    elif _axis_is_bound(axis):
         return _broadcast_traced(tensor, axis, root_rank,
                                  pset.axis_index_groups(), pset.ranks)
-    if _contains_tracer(tensor):
+    elif _contains_tracer(tensor):
         raise RuntimeError(
             "broadcast() was called inside jit/pjit without a bound mesh axis. "
             "Run it under jax.shard_map over hvd.mesh() (or pass axis_name=) "
@@ -1020,11 +1460,24 @@ def grouped_broadcast(tensors: Sequence, root_rank: int, *,
     axis = _resolve_axis(axis_name)
     if root_rank not in pset.ranks:
         raise ValueError(f"root_rank {root_rank} not in process set {pset.ranks}")
-    if _axis_is_bound(axis):
+    if _compat.trace_state_clean():
+        sigs = (tuple(_plan_sig(t) for t in tensors)
+                if _dispatch.enabled() else (None,))
+        if all(s is not None for s in sigs):
+            key = ("grouped_broadcast", name, sigs, axis,
+                   pset.dispatch_key(), root_rank,
+                   envs.fusion_threshold_bytes())
+            plan = _dispatch.lookup(key)
+            if plan is None:
+                plan = _build_grouped_broadcast_plan(tensors, sigs, pset,
+                                                     axis, root_rank, name)
+                _dispatch.store(key, plan)
+            return plan.run(tensors)
+    elif _axis_is_bound(axis):
         groups = pset.axis_index_groups()
         return [_broadcast_traced(t, axis, root_rank, groups, pset.ranks)
                 for t in tensors]
-    if any(_contains_tracer(t) for t in tensors):
+    elif any(_contains_tracer(t) for t in tensors):
         raise RuntimeError(
             "grouped_broadcast() was called inside jit/pjit without a bound "
             "mesh axis. Run it under jax.shard_map over hvd.mesh() (or pass "

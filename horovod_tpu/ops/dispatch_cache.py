@@ -1,0 +1,198 @@
+"""Steady-state dispatch plan cache for eager collectives.
+
+The Python twin of the reference's ResponseCache fast path
+(``response_cache.h:107-169``; served in ``ComputeResponseList``'s HIT
+branch, ``controller.cc:73-430``): the native engine already skips the
+*cross-process* metadata exchange for repeated collectives, but every
+eager call still paid the full *per-call Python dispatch* — exception-probed
+mode detection, bundle materialization, mesh hashing through several
+``lru_cache`` layers, fusion re-bucketing, negotiation/autotune/timeline
+bookkeeping. A :class:`DispatchPlan` captures all of those decisions on the
+first call; subsequent calls with the same key go straight from user tensor
+to the compiled ``jit(shard_map(...))`` invocation.
+
+Keys cover (op kind, user name, per-rank shape, dtype, process-set key,
+reduce op, pre/post scale, hierarchical flag) — anything that changes the
+compiled program or the negotiated metadata. Capacity and the off switch
+ride the existing ``HVD_CACHE_CAPACITY`` knob (reference default 1024,
+``global_state.h:89``; 0 disables caching entirely). The whole cache is
+flushed ("invalidated") when the runtime generation changes
+(``shutdown()``/``init()``), when a process set is removed, when the
+negotiation services reset, or when a knob override changes (the autotuner
+retunes ``FUSION_THRESHOLD``/``HIERARCHICAL_ALLREDUCE``/… — any of which
+changes plan contents).
+
+Statistics surface through :func:`stats` (exported as
+``hvd.dispatch_cache_stats()``) and, when a timeline is recording, as
+instant ``PLAN_HIT``/``PLAN_MISS`` events per op lane.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+from .. import autotune as _autotune
+from .. import timeline as _timeline
+from ..utils import envs
+
+
+class DispatchPlan:
+    """One fully-resolved eager dispatch: negotiation decision, payload
+    accounting, timeline labels, and the executor closure wrapping the
+    compiled program. ``negotiate`` is ``None`` when the plan pinned the
+    no-service decision (single-process job / non-member) — the per-call
+    ``get_service`` + auto-name round is skipped entirely."""
+
+    __slots__ = ("label", "activity", "nbytes", "negotiate", "execute")
+
+    def __init__(self, label: str, activity: str, nbytes: int | None,
+                 negotiate: Callable | None, execute: Callable):
+        self.label = label
+        self.activity = activity
+        self.nbytes = nbytes
+        self.negotiate = negotiate
+        self.execute = execute
+
+    def run(self, arg):
+        if self.negotiate is None:
+            note_negotiation_skip()
+        else:
+            self.negotiate()
+        if self.nbytes is not None:
+            _autotune.record(self.nbytes)
+        with _timeline.op_range(self.label, self.activity):
+            return self.execute(arg)
+
+
+# Cached negative decision: this signature can never be planned (e.g.
+# multi-process allgather, whose program shape depends on the negotiated
+# recv_splits). Stored like a plan so repeated calls skip both the rebuild
+# attempt AND the miss counter.
+UNPLANNABLE = object()
+
+_lock = threading.Lock()
+_plans: "OrderedDict[tuple, DispatchPlan]" = OrderedDict()
+_epoch: tuple | None = None
+_hits = 0
+_misses = 0
+_invalidations = 0
+_evictions = 0
+_negotiation_skips = 0
+
+
+def capacity() -> int:
+    """Live capacity from ``HVD_CACHE_CAPACITY`` (0 = caching off). Read
+    per lookup so tests and the autotuner can flip it at runtime."""
+    return envs.cache_capacity()
+
+
+def enabled() -> bool:
+    return capacity() > 0
+
+
+def _current_epoch() -> tuple:
+    from .. import runtime
+    return (runtime.generation(), envs.override_epoch())
+
+
+def _flush_locked(count_invalidation: bool) -> None:
+    global _invalidations
+    if count_invalidation:
+        _invalidations += len(_plans)
+    _plans.clear()
+
+
+def lookup(key: tuple) -> DispatchPlan | None:
+    """Plan for ``key``, or None (miss / caching disabled). Epoch drift
+    (re-init, knob override change) flushes before the lookup so a stale
+    plan can never serve."""
+    global _hits, _misses, _epoch
+    if capacity() <= 0:
+        return None
+    epoch = _current_epoch()
+    with _lock:
+        if _epoch != epoch:
+            _flush_locked(count_invalidation=_epoch is not None)
+            _epoch = epoch
+        plan = _plans.get(key)
+        if plan is None:
+            _misses += 1
+            return None
+        _plans.move_to_end(key)
+        if plan is UNPLANNABLE:
+            return plan  # negative decision: neither a hit nor a miss
+        _hits += 1
+    _timeline.record_dispatch(plan.label, hit=True)
+    return plan
+
+
+def store(key: tuple, plan: DispatchPlan) -> None:
+    """Insert ``plan`` (LRU-evicting past capacity). No-op when caching is
+    disabled, so the build-per-call path stays allocation-clean."""
+    global _evictions, _epoch
+    cap = capacity()
+    if cap <= 0:
+        return
+    epoch = _current_epoch()
+    with _lock:
+        if _epoch != epoch:
+            _flush_locked(count_invalidation=_epoch is not None)
+            _epoch = epoch
+        _plans[key] = plan
+        _plans.move_to_end(key)
+        while len(_plans) > cap:
+            _plans.popitem(last=False)
+            _evictions += 1
+    if plan is not UNPLANNABLE:
+        _timeline.record_dispatch(plan.label, hit=False)
+
+
+def invalidate(reason: str | None = None) -> int:
+    """Flush every cached plan (process-set removal, service reset,
+    shutdown). Returns the number of plans dropped."""
+    del reason
+    with _lock:
+        n = len(_plans)
+        _flush_locked(count_invalidation=True)
+    return n
+
+
+def note_negotiation_skip() -> None:
+    """Account one negotiation round skipped — either the plan pinned the
+    no-service decision, or the engine served the round from its response
+    cache (``from_cache``, the reference's bitvector HIT path)."""
+    global _negotiation_skips
+    _negotiation_skips += 1
+
+
+def stats() -> dict:
+    """Plan-cache counters (the ``hvd.dispatch_cache_stats()`` API)."""
+    with _lock:
+        return {
+            "enabled": enabled(),
+            "capacity": capacity(),
+            "size": len(_plans),
+            "hits": _hits,
+            "misses": _misses,
+            "invalidations": _invalidations,
+            "evictions": _evictions,
+            "negotiation_skips": _negotiation_skips,
+        }
+
+
+def reset_stats() -> None:
+    global _hits, _misses, _invalidations, _evictions, _negotiation_skips
+    with _lock:
+        _hits = _misses = _invalidations = _evictions = 0
+        _negotiation_skips = 0
+
+
+def reset() -> None:
+    """Tests / teardown: drop plans AND counters."""
+    global _epoch
+    with _lock:
+        _plans.clear()
+        _epoch = None
+    reset_stats()

@@ -141,46 +141,74 @@ def hierarchical_allgather_traced(x, ici_axis, dcn_axis):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _eager_hier_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float, post: float):
+def _eager_hier_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float, post: float,
+                             bundled: bool = True, row0: bool = False):
+    """``bundled``: x is the (n, ...) per-rank bundle. Replicated
+    (``bundled=False``): x is the raw array every rank contributes
+    identically — ``in_specs=P()`` replicates without bundle
+    materialization. ``row0``: return the replicated result directly
+    (``out_specs=P()``) so dispatch plans need no eager ``[0]`` slice
+    (see the flat twins in ops/collectives.py)."""
     dcn_axis, ici_axis = mesh.axis_names
 
-    def inner(x):  # (1, ...) bundle shard -> (1, ...) reduced
+    def inner(x):
         out = hierarchical_allreduce_traced(
-            x[0], ici_axis, dcn_axis, op=op,
+            x[0] if bundled else x, ici_axis, dcn_axis, op=op,
             prescale_factor=pre, postscale_factor=post)
-        return out[None]
+        return out[None] if (bundled and not row0) else out
 
+    in_spec = P((dcn_axis, ici_axis)) if bundled else P()
+    out_spec = P() if (row0 or not bundled) else in_spec
     return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)),
-        out_specs=P((dcn_axis, ici_axis)), check_vma=False))
+        inner, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_vma=False))
+
+
+def _hier_grouped_allreduce_smap(mesh: Mesh, op: ReduceOp, pre: float,
+                                 post: float, num_bufs: int, bundled: bool):
+    """Raw shard-mapped two-level fused reduction (not jitted) — composed
+    into the jitted wire program below and into dispatch-plan programs."""
+    dcn_axis, ici_axis = mesh.axis_names
+
+    def inner(*xs):
+        if bundled:
+            return tuple(
+                hierarchical_allreduce_traced(
+                    x[0], ici_axis, dcn_axis, op=op,
+                    prescale_factor=pre, postscale_factor=post)[None]
+                for x in xs)
+        return tuple(
+            hierarchical_allreduce_traced(
+                x, ici_axis, dcn_axis, op=op,
+                prescale_factor=pre, postscale_factor=post)
+            for x in xs)
+
+    spec = P((dcn_axis, ici_axis)) if bundled else P()
+    specs = tuple(spec for _ in range(num_bufs))
+    return jax.shard_map(inner, mesh=mesh, in_specs=specs, out_specs=specs,
+                         check_vma=False)
 
 
 @functools.lru_cache(maxsize=None)
 def _eager_hier_grouped_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float,
-                                     post: float, num_bufs: int):
-    dcn_axis, ici_axis = mesh.axis_names
-
-    def inner(*xs):
-        return tuple(
-            hierarchical_allreduce_traced(
-                x[0], ici_axis, dcn_axis, op=op,
-                prescale_factor=pre, postscale_factor=post)[None]
-            for x in xs)
-
-    specs = tuple(P((dcn_axis, ici_axis)) for _ in range(num_bufs))
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=specs, out_specs=specs, check_vma=False))
+                                     post: float, num_bufs: int,
+                                     bundled: bool = True,
+                                     donate: tuple = ()):
+    return jax.jit(
+        _hier_grouped_allreduce_smap(mesh, op, pre, post, num_bufs, bundled),
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
 
 
 @functools.lru_cache(maxsize=None)
-def _eager_hier_allgather_fn(mesh: Mesh):
+def _eager_hier_allgather_fn(mesh: Mesh, bundled: bool = True):
     dcn_axis, ici_axis = mesh.axis_names
 
-    def inner(x):  # (1, d0, ...) -> (n*d0, ...) replicated
-        return hierarchical_allgather_traced(x[0], ici_axis, dcn_axis)
+    def inner(x):  # -> (n*d0, ...) replicated
+        return hierarchical_allgather_traced(x[0] if bundled else x,
+                                             ici_axis, dcn_axis)
 
     return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)),
+        inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)) if bundled else P(),
         out_specs=P(), check_vma=False))
 
 

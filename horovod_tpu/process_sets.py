@@ -82,6 +82,16 @@ class ProcessSet:
             self._mesh_generation = gen
         return self._mesh
 
+    def dispatch_key(self):
+        """Stable hashable identity for dispatch-plan cache keys: the
+        registered id (unique while registered — removal flushes the plan
+        cache, so a free-listed id can never serve a stale plan), the rank
+        tuple for unregistered subsets, or "g" for an unregistered
+        global-view set."""
+        if self.process_set_id is not None:
+            return self.process_set_id
+        return tuple(self._ranks) if self._ranks is not None else "g"
+
     def axis_index_groups(self) -> list[list[int]] | None:
         """Partition of the global axis for traced-mode collectives.
 
@@ -185,3 +195,7 @@ def add_process_set(process_set: ProcessSet | Sequence[int]) -> ProcessSet:
 
 def remove_process_set(process_set: ProcessSet) -> None:
     runtime.process_set_table().remove(process_set)
+    # The freed id may be reissued to a different rank list; drop every
+    # dispatch plan rather than risk one keyed on the stale id serving.
+    from .ops import dispatch_cache
+    dispatch_cache.invalidate("process set removed")

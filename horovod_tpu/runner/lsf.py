@@ -26,6 +26,7 @@ import collections
 import os
 
 from . import hosts as hosts_mod
+from ..utils import logging as hvd_logging
 
 
 def using_lsf() -> bool:
@@ -44,6 +45,16 @@ def _drop_launch_nodes(names: list[str]) -> list[str]:
     pass ``-H``/``--hostfile`` explicitly."""
     kept = [n for n in names
             if not n.lower().startswith(("batch", "login"))]
+    if kept and len(kept) < len(names):
+        # a cluster naming real compute hosts batch*/login* would be
+        # silently shrunk here — say exactly what was filtered so a
+        # mis-filtered allocation is visible (escape hatch: -H/--hostfile)
+        dropped = sorted({n for n in names if n not in set(kept)})
+        hvd_logging.info(
+            "LSF allocation: dropping launch node(s) %s (batch*/login* "
+            "prefix); %d compute host(s) remain. Pass -H/--hostfile if "
+            "these are real compute hosts.", ", ".join(dropped),
+            len(set(kept)))
     return kept if kept else names
 
 

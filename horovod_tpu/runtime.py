@@ -361,8 +361,12 @@ def shutdown() -> None:
     global _state, _bootstrap_kv_server, _bootstrap_seeded_env
     from . import autotune as _autotune
     from . import engine_service as _engine_service
+    from .ops import dispatch_cache as _dispatch_cache
     _engine_service.reset_service()
     _autotune.reset()
+    # Plans hold compiled programs over this world's meshes; none survive
+    # a shutdown (the generation epoch also guards re-init races).
+    _dispatch_cache.invalidate("runtime shutdown")
     if _bootstrap_kv_server is not None:
         try:
             _bootstrap_kv_server.stop()

@@ -103,6 +103,15 @@ def maybe_autostart() -> None:
         hvd_logging.error("cannot start timeline at %s: %s", path, e)
 
 
+def record_dispatch(tensor: str, hit: bool) -> None:
+    """Instant plan-cache marker on the op's lane (``PLAN_HIT`` /
+    ``PLAN_MISS``) so steady-state dispatch behavior is visible next to
+    the NEGOTIATE/op ranges. Cheap no-op guard on the hot path; full
+    counters live in ``hvd.dispatch_cache_stats()``."""
+    if _active:
+        record(tensor, "PLAN_HIT" if hit else "PLAN_MISS", PHASE_INSTANT)
+
+
 def record(tensor: str, activity: str, phase: int) -> None:
     """Record one event when the timeline is active (cheap no-op guard on
     the hot path)."""

@@ -1,0 +1,75 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern ``jax.shard_map`` entry point (promoted to
+the top-level namespace with the ``check_vma`` keyword). Older jax releases
+(<= 0.4.x) only ship ``jax.experimental.shard_map.shard_map`` with the
+keyword spelled ``check_rep``. Installing the adapter onto the ``jax``
+module keeps every call site — library, tests, examples — on the one
+modern spelling instead of scattering try/except imports.
+
+Imported for its side effect from ``horovod_tpu/__init__`` before anything
+can touch ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map_shim() -> None:
+    if hasattr(jax, "shard_map"):
+        return  # modern jax: nothing to adapt
+
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:  # pragma: no cover - no known jax lacks both
+        return
+
+    @functools.wraps(_legacy)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+                  check_vma=None, check_rep=None, **kwargs):
+        # check_vma is the modern name for what 0.4.x calls check_rep;
+        # accept either, prefer the explicit legacy spelling if given.
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_rep, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+_install_shard_map_shim()
+
+
+def _resolve_trace_state_clean():
+    """Find ``trace_state_clean`` across jax versions: the public
+    ``jax.core`` home first, then ``jax._src.core`` (modern releases have
+    been emptying ``jax.core``). Returning None (no probe found) makes
+    :func:`trace_state_clean` answer False, which keeps callers on the
+    exception-probed legacy path — correct, just slower."""
+    fn = getattr(jax.core, "trace_state_clean", None)
+    if fn is not None:
+        return fn
+    try:  # pragma: no cover - exercised only on jax without jax.core's
+        from jax._src import core as _src_core
+        return getattr(_src_core, "trace_state_clean", None)
+    except ImportError:
+        return None
+
+
+_trace_state_clean = _resolve_trace_state_clean()
+
+
+def trace_state_clean() -> bool:
+    """True when no jax trace is in progress — a concrete-value call site
+    is definitely in eager mode (the cheap half of mode detection; the
+    exception-probed ``lax.axis_index`` stays as the fallback for jax
+    builds without the helper)."""
+    if _trace_state_clean is None:  # pragma: no cover
+        return False
+    try:
+        return bool(_trace_state_clean())
+    except Exception:  # pragma: no cover - defensive
+        return False

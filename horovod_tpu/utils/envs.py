@@ -71,17 +71,38 @@ _PREFIXES = ("HVD_", "HOROVOD_")
 # that read knobs through this module pick up tuned values transparently.
 _overrides: dict[str, str] = {}
 
+# Bumped on every override mutation. Consumers that cache derived state
+# (the dispatch plan cache keys fusion layouts and hierarchical routing off
+# knob values) compare epochs instead of re-reading every knob per call.
+_override_epoch = 0
+
+
+def override_epoch() -> int:
+    """Monotonic counter of override mutations (see ``_override_epoch``)."""
+    return _override_epoch
+
 
 def set_override(name: str, value) -> None:
     """Install a runtime override for knob ``name`` (autotuner)."""
-    _overrides[name] = str(value)
+    global _override_epoch
+    value = str(value)
+    if _overrides.get(name) == value:
+        return  # no-op re-apply (every autotune sample re-applies the
+        # whole state) must not bump the epoch and flush dispatch plans
+    _overrides[name] = value
+    _override_epoch += 1
 
 
 def clear_override(name: str) -> None:
-    _overrides.pop(name, None)
+    global _override_epoch
+    if _overrides.pop(name, None) is not None:
+        _override_epoch += 1
 
 
 def clear_overrides() -> None:
+    global _override_epoch
+    if _overrides:
+        _override_epoch += 1
     _overrides.clear()
 
 

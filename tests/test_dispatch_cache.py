@@ -1,0 +1,200 @@
+"""Dispatch plan cache (ISSUE 1 tentpole): the steady-state eager fast
+path must hit/miss/invalidate correctly, produce numerics identical to the
+cache-off (pre-cache) dispatch path, and never let wire-buffer donation
+corrupt a caller's reused input."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import dispatch_cache
+from horovod_tpu.utils import envs
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dispatch_cache.reset()
+    yield
+    dispatch_cache.reset()
+
+
+def _vals(shape=(4,), dtype=jnp.float32, mult=1.0):
+    return [jnp.full(shape, (i + 1) * mult, dtype) for i in range(N)]
+
+
+# ------------------------------------------------------------- hit / miss
+
+def test_repeated_signature_hits(hvd):
+    pr = hvd.per_rank(_vals())
+    hvd.allreduce(pr, op=hvd.Sum)
+    s0 = dispatch_cache.stats()
+    assert s0["misses"] >= 1 and s0["size"] >= 1
+    hvd.allreduce(pr, op=hvd.Sum)
+    hvd.allreduce(hvd.per_rank(_vals()), op=hvd.Sum)  # fresh arrays, same sig
+    s1 = dispatch_cache.stats()
+    assert s1["hits"] == s0["hits"] + 2
+    assert s1["misses"] == s0["misses"]
+
+
+def test_negotiation_skips_counted(hvd):
+    pr = hvd.per_rank(_vals())
+    for _ in range(3):
+        hvd.allreduce(pr, op=hvd.Sum)
+    # single-process job: every plan run skips the negotiation entry
+    assert dispatch_cache.stats()["negotiation_skips"] >= 3
+
+
+def test_shape_change_is_a_miss(hvd):
+    hvd.allreduce(hvd.per_rank(_vals((4,))), op=hvd.Sum)
+    s0 = dispatch_cache.stats()
+    hvd.allreduce(hvd.per_rank(_vals((5,))), op=hvd.Sum)
+    s1 = dispatch_cache.stats()
+    assert s1["misses"] == s0["misses"] + 1
+    assert s1["size"] == s0["size"] + 1
+
+
+def test_dtype_change_is_a_miss(hvd):
+    hvd.allreduce(hvd.per_rank(_vals(dtype=jnp.float32)), op=hvd.Sum)
+    s0 = dispatch_cache.stats()
+    hvd.allreduce(hvd.per_rank(_vals(dtype=jnp.int32)), op=hvd.Sum)
+    s1 = dispatch_cache.stats()
+    assert s1["misses"] == s0["misses"] + 1
+
+
+def test_op_and_scale_in_key(hvd):
+    pr = hvd.per_rank(_vals())
+    hvd.allreduce(pr, op=hvd.Sum)
+    s0 = dispatch_cache.stats()
+    hvd.allreduce(pr, op=hvd.Max)
+    hvd.allreduce(pr, op=hvd.Sum, postscale_factor=0.5)
+    s1 = dispatch_cache.stats()
+    assert s1["misses"] == s0["misses"] + 2
+
+
+# ---------------------------------------------------------- invalidation
+
+def test_process_set_removal_invalidates(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    vals = [jnp.full((3,), i + 1.0) for i in range(4)]
+    out = hvd.allreduce(hvd.per_rank(vals, ps), op=hvd.Sum, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out), np.full((3,), 10.0))
+    assert dispatch_cache.stats()["size"] >= 1
+    hvd.remove_process_set(ps)
+    s = dispatch_cache.stats()
+    assert s["size"] == 0
+    assert s["invalidations"] >= 1
+
+
+def test_knob_override_change_flushes(hvd):
+    pr = hvd.per_rank(_vals())
+    hvd.allreduce(pr, op=hvd.Sum)
+    assert dispatch_cache.stats()["size"] >= 1
+    envs.set_override(envs.FUSION_THRESHOLD, 12345)
+    try:
+        hvd.allreduce(pr, op=hvd.Sum)  # epoch drift -> flush, then rebuild
+        s = dispatch_cache.stats()
+        assert s["invalidations"] >= 1
+    finally:
+        envs.clear_override(envs.FUSION_THRESHOLD)
+
+
+def test_capacity_zero_disables(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_CACHE_CAPACITY", "0")
+    out = hvd.allreduce(hvd.per_rank(_vals()), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 36.0))
+    s = dispatch_cache.stats()
+    assert s["enabled"] is False
+    assert s["size"] == 0 and s["hits"] == 0 and s["misses"] == 0
+
+
+def test_lru_eviction(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_CACHE_CAPACITY", "2")
+    for d in (3, 4, 5, 6):
+        hvd.allreduce(hvd.per_rank(_vals((d,))), op=hvd.Sum)
+    s = dispatch_cache.stats()
+    assert s["size"] <= 2
+    assert s["evictions"] >= 2
+
+
+# ------------------------------------------------- cache on/off numerics
+
+def _run_ops(hvd):
+    pr = hvd.per_rank(_vals((6,)))
+    group = [hvd.per_rank(_vals((6,))), hvd.per_rank(_vals((2, 3), mult=10.0)),
+             jnp.ones((5,))]
+    return [
+        hvd.allreduce(pr, op=hvd.Sum),
+        hvd.allreduce(jnp.arange(12.0), op=hvd.Sum),        # replicated
+        *hvd.grouped_allreduce(group, op=hvd.Average),
+        hvd.broadcast(pr, root_rank=2),
+        hvd.broadcast(jnp.arange(4.0), root_rank=0),        # replicated
+        hvd.allgather(pr),
+        hvd.allgather(jnp.ones((2, 2))),                    # replicated
+        *hvd.grouped_broadcast(group, root_rank=1),
+    ]
+
+
+def test_numerics_identical_cache_on_off(hvd, monkeypatch):
+    first = _run_ops(hvd)     # cache on: plan builds
+    hits = _run_ops(hvd)      # cache on: plan hits
+    assert dispatch_cache.stats()["hits"] > 0
+    monkeypatch.setenv("HVD_CACHE_CAPACITY", "0")
+    off = _run_ops(hvd)       # pre-cache dispatch path
+    for a, b, c in zip(first, hits, off):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c))
+
+
+# ------------------------------------------------------- donation safety
+
+def test_donation_does_not_corrupt_reused_inputs(hvd):
+    """Grouped wire buffers are donated; calling again with the SAME input
+    arrays (the training-loop pattern) must neither fail on a deleted
+    buffer nor change results."""
+    group = [hvd.per_rank(_vals((4,))), hvd.per_rank(_vals((2, 3))),
+             jnp.arange(8.0)]
+    ref = [np.asarray(o) for o in hvd.grouped_allreduce(group, op=hvd.Sum)]
+    for _ in range(3):
+        outs = hvd.grouped_allreduce(group, op=hvd.Sum)
+    for a, b in zip(ref, outs):
+        np.testing.assert_allclose(np.asarray(b), a)
+    # the inputs themselves must still be readable and unchanged
+    np.testing.assert_allclose(np.asarray(group[0].array[3]),
+                               np.full((4,), 4.0))
+    np.testing.assert_allclose(np.asarray(group[2]), np.arange(8.0))
+
+
+def test_donation_single_tensor_group_aliasing(hvd):
+    """A single-tensor bucket's wire buffer can be the caller's own array
+    (identity-reshape fast path) — it must be excluded from donation."""
+    pr = hvd.per_rank(_vals((4,)))  # (8, 4) bundle: the aliasing shape
+    for _ in range(3):
+        out = hvd.grouped_allreduce([pr], op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out[0]), np.full((4,), 36.0))
+    np.testing.assert_allclose(np.asarray(pr.array[0]), np.full((4,), 1.0))
+    x = jnp.arange(8.0)  # 1-D raw array: flat-path aliasing shape
+    for _ in range(3):
+        out2 = hvd.grouped_allreduce([x], op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out2[0]), np.arange(8.0) * 8)
+    np.testing.assert_allclose(np.asarray(x), np.arange(8.0))
+
+
+def test_grouped_broadcast_donation_safe(hvd):
+    group = [hvd.per_rank(_vals((4,))), hvd.per_rank(_vals((3,), mult=2.0))]
+    for _ in range(3):
+        outs = hvd.grouped_broadcast(group, root_rank=5)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((4,), 6.0))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full((3,), 12.0))
+    np.testing.assert_allclose(np.asarray(group[0].array[7]),
+                               np.full((4,), 8.0))
+
+
+# ----------------------------------------------------------- stats API
+
+def test_stats_api_exported(hvd):
+    s = hvd.dispatch_cache_stats()
+    for key in ("enabled", "capacity", "size", "hits", "misses",
+                "invalidations", "negotiation_skips"):
+        assert key in s
