@@ -78,9 +78,11 @@ from .ops import (
     broadcast_async,
     broadcast_object,
     dispatch_cache_stats,
+    fusion_stats,
     grouped_allreduce,
     grouped_allreduce_async,
     grouped_broadcast,
+    grouped_broadcast_async,
     hierarchical_allgather,
     hierarchical_allreduce,
     hierarchical_mesh,
@@ -150,8 +152,9 @@ __all__ = [
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
     "broadcast_", "broadcast_async", "broadcast_object",
-    "dispatch_cache_stats",
+    "dispatch_cache_stats", "fusion_stats",
     "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
+    "grouped_broadcast_async",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",

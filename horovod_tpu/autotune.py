@@ -27,8 +27,12 @@ TPU rebuild; ``operations.cc:584-594``):
 * ``FUSION_THRESHOLD`` — eager fusion bucket size in bytes: how much of a
   grouped op's payload is packed into one wire buffer / one compiled
   program (consumer: ``ops/collectives._fuse_by_dtype``).
-* ``CYCLE_TIME`` — dynamic-engine negotiation cycle in ms (consumer:
-  ``engine_service.DynamicService``; re-read every cycle).
+* ``CYCLE_TIME`` — fusion-cycle flush pace for queued async collectives
+  (consumer: ``ops/fusion_cycle.FusionScheduler``) and the dynamic-engine
+  negotiation cycle in ms (consumer: ``engine_service.DynamicService``);
+  both re-read it live.
+* ``PENDING_CYCLE_TIME`` — the faster pace both consumers drop to while
+  work is in flight.
 * ``HIERARCHICAL_ALLREDUCE`` — flat vs two-level ICI/DCN schedule
   (consumer: ``ops/hierarchical.hierarchical_enabled_for``).
 * ``CACHE_CAPACITY`` — dispatch-plan/response cache on/off (the
@@ -100,7 +104,14 @@ def _default_tunables() -> list[Tunable]:
     return [
         Tunable(envs.FUSION_THRESHOLD,
                 [1 * MB, 4 * MB, 16 * MB, 64 * MB, 128 * MB, 256 * MB]),
+        # CYCLE_TIME now drives TWO consumers: the dynamic engine's
+        # negotiation tick AND the fusion-cycle flush pace of queued
+        # async collectives (ops/fusion_cycle.py; both re-read the knob
+        # live, so tuned values take effect between flushes).
         Tunable(envs.CYCLE_TIME, [1.0, 2.5, 5.0, 10.0, 20.0, 40.0]),
+        # Flush pace while work is in flight (fusion cycle) / in-flight
+        # negotiation tick floor (engine service).
+        Tunable(envs.PENDING_CYCLE_TIME, [0.5, 1.0, 2.0, 5.0]),
         Tunable(envs.HIERARCHICAL_ALLREDUCE, [0, 1]),
         # Dispatch-plan/response cache on/off, the reference's cache_enabled
         # tunable (parameter_manager.cc CacheEnabledParameter). Default-on

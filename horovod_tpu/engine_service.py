@@ -477,6 +477,18 @@ def get_service(pset=None) -> DynamicService | None:
 def reset_service() -> None:
     """Tear down all per-set services (elastic re-init / tests)."""
     global _service_unavailable
+    # Entries still queued in the fusion cycle pinned THIS world's
+    # services and negotiation names — they can never execute after the
+    # reset. Fail them (handles raise at synchronize) instead of leaving
+    # their waiters hanging; a clean shutdown() drains the queues first,
+    # so this only bites abandoned handles and elastic teardowns.
+    from .ops import fusion_cycle
+    aborted = fusion_cycle.abort("engine service reset")
+    if aborted:
+        hvd_logging.warning(
+            "engine service reset aborted %d queued async collectives "
+            "(synchronize their handles before shutdown/reset to land "
+            "them)", aborted)
     with _service_lock:
         for svc in _services.values():
             svc.stop()

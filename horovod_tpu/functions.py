@@ -24,12 +24,15 @@ def broadcast_parameters(params, root_rank: int = 0,
                          process_set: ProcessSet | None = None):
     """Broadcast a pytree of arrays from ``root_rank`` to all ranks
     (reference ``broadcast_parameters``, ``torch/functions.py``).
-    Returns the synchronized pytree. Leaves are fused per dtype into
-    single wire buffers (see ``grouped_broadcast``)."""
+    Returns the synchronized pytree. Leaves ride the fusion-cycle
+    broadcast queue and are fused per dtype into single wire buffers at
+    the flush (see ``grouped_broadcast``) — a model broadcast coalesces
+    with any other pending broadcasts of the same root before the
+    synchronize drains the queue."""
     leaves, treedef = jax.tree.flatten(params)
-    synced = collectives.grouped_broadcast(
+    handle = collectives.grouped_broadcast_async(
         leaves, root_rank, process_set=process_set)
-    return jax.tree.unflatten(treedef, synced)
+    return jax.tree.unflatten(treedef, handle.synchronize())
 
 
 # TF-parity alias (reference ``broadcast_variables``, tensorflow/functions.py)

@@ -17,6 +17,7 @@ from .collectives import (
     grouped_allreduce,
     grouped_allreduce_async,
     grouped_broadcast,
+    grouped_broadcast_async,
     join,
     per_rank,
     poll,
@@ -25,6 +26,8 @@ from .collectives import (
 )
 from .dispatch_cache import reset as reset_dispatch_cache
 from .dispatch_cache import stats as dispatch_cache_stats
+from .fusion_cycle import reset as reset_fusion_cycle
+from .fusion_cycle import stats as fusion_stats
 from .adasum import adasum_allreduce
 from .hierarchical import (
     hierarchical_allgather,
@@ -45,9 +48,11 @@ __all__ = [
     "Compression", "Handle", "PerRank", "allgather", "allgather_async",
     "allgather_object", "allreduce", "allreduce_async", "alltoall",
     "alltoall_async", "barrier", "broadcast", "broadcast_async",
-    "broadcast_object", "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast", "join", "per_rank", "poll",
-    "reducescatter", "synchronize", "adasum_allreduce",
+    "broadcast_object", "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_broadcast", "grouped_broadcast_async", "join", "per_rank",
+    "poll", "reducescatter", "synchronize", "adasum_allreduce",
     "dispatch_cache_stats", "reset_dispatch_cache",
+    "fusion_stats", "reset_fusion_cycle",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",
     "sparse_allreduce_to_dense",

@@ -487,15 +487,33 @@ def _eager_grouped_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
         donate_argnums=tuple(i for i, d in enumerate(donate) if d))
 
 
-def _fusion_buckets(tensors, threshold: int, elem_count):
+def _wire_dtype_of(t, compression):
+    """The dtype a tensor travels the wire in: its own dtype, or the
+    compressor's wire dtype for floating tensors routed through
+    ``Compression.bf16``/``fp16`` (integers pass through uncompressed,
+    matching ``_CastCompressor.compress``)."""
+    dt = jnp.result_type(t.array if isinstance(t, PerRank) else t)
+    wire = getattr(compression, "wire_dtype", None)
+    if wire is not None and jnp.issubdtype(dt, jnp.floating):
+        return jnp.dtype(wire)
+    return jnp.dtype(dt)
+
+
+def _fusion_buckets(tensors, threshold: int, elem_count, dtype_of=None):
     """THE fusion bucketing rule, shared by the eager wire buffers and the
     opt-in traced fusion: group indices by dtype, then split each group
     into buckets whose total bytes stay <= ``threshold`` (a single
     oversized tensor gets its own bucket). ``elem_count(t)`` gives the
-    per-rank element count of one tensor. Yields (dtype, [indices])."""
+    per-rank element count of one tensor. Buckets are keyed by the WIRE
+    dtype — ``dtype_of(i)`` when given (tensors routed through
+    ``Compression.bf16``/``fp16`` fuse together instead of fragmenting
+    into per-source-dtype buckets), else the tensor's own dtype. Yields
+    (dtype, [indices])."""
     by_dtype: dict = {}
     for i, t in enumerate(tensors):
-        by_dtype.setdefault(jnp.result_type(t), []).append(i)
+        dt = jnp.dtype(dtype_of(i)) if dtype_of is not None \
+            else jnp.dtype(jnp.result_type(t))
+        by_dtype.setdefault(dt, []).append(i)
     for dt, idxs in by_dtype.items():
         itemsize = jnp.dtype(dt).itemsize
         bucket: list = []
@@ -511,31 +529,57 @@ def _fusion_buckets(tensors, threshold: int, elem_count):
             yield dt, bucket
 
 
-def _fuse_by_dtype(bundles: list, n: int):
-    """Pack (n, ...) bundles into flat (n, total) wire buffers per dtype
-    (the XLA analog of the reference's fusion buffer,
+def _fuse_by_dtype(bundles: list, n: int, wire_dtypes=None):
+    """Pack (n, ...) bundles into flat (n, total) wire buffers per WIRE
+    dtype (the XLA analog of the reference's fusion buffer,
     ``fusion_buffer_manager.h:30-50``), each bucket capped at the fusion
     threshold (``HVD_FUSION_THRESHOLD``; reference default 128 MB,
     ``operations.cc:491-496`` — the autotuner tunes this knob at runtime).
-    Returns (fused_inputs, metas)."""
+    ``wire_dtypes[i]`` (compression routing) keys the buckets and casts on
+    pack; :func:`_split_fused` casts back to each tensor's source dtype
+    after the split. Returns (fused_inputs, metas)."""
     fused_inputs, metas = [], []
+    wire_of = (lambda i: wire_dtypes[i]) if wire_dtypes is not None else None
     for dt, bidxs in _fusion_buckets(
             bundles, envs.fusion_threshold_bytes(),
-            lambda b: int(np.prod(b.shape[1:]) or 1)):
-        flat = [bundles[i].reshape(n, -1) for i in bidxs]
+            lambda b: int(np.prod(b.shape[1:]) or 1), dtype_of=wire_of):
+        flat = [(bundles[i] if bundles[i].dtype == dt
+                 else bundles[i].astype(dt)).reshape(n, -1) for i in bidxs]
         fused_inputs.append(jnp.concatenate(flat, axis=1))
-        metas.append((dt, bidxs, [bundles[i].shape[1:] for i in bidxs]))
+        metas.append((dt, bidxs, [bundles[i].shape[1:] for i in bidxs],
+                      [jnp.dtype(bundles[i].dtype) for i in bidxs]))
     return fused_inputs, metas
 
 
+def _fusion_metas(per_shapes, src_dtypes, wire_dtypes):
+    """Bucket layout (metas) from shapes/dtypes alone — the pure-metadata
+    twin of :func:`_fuse_by_dtype` (and of the replicated-strategy fuse
+    closure in :func:`_plan_fused_programs`) for plan builders,
+    which only need the layout: materializing throwaway device bundles
+    just to read it back would cost an O(payload) allocation per plan
+    build (and plans rebuild on every autotune epoch flush)."""
+    idxs = list(range(len(per_shapes)))
+    metas = []
+    for dt, bidxs in _fusion_buckets(
+            idxs, envs.fusion_threshold_bytes(),
+            lambda i: int(np.prod(per_shapes[i]) or 1),
+            dtype_of=lambda i: wire_dtypes[i]):
+        metas.append((dt, bidxs, [tuple(per_shapes[i]) for i in bidxs],
+                      [jnp.dtype(src_dtypes[i]) for i in bidxs]))
+    return metas
+
+
 def _split_fused(fused_outputs, metas, count: int) -> list:
-    """Inverse of :func:`_fuse_by_dtype` on flat per-dtype result vectors."""
+    """Inverse of :func:`_fuse_by_dtype` on flat per-dtype result vectors
+    (decompressing — casting back to the source dtype — any tensor that
+    traveled in a different wire dtype)."""
     results: list = [None] * count
-    for vec, (dt, idxs, shapes) in zip(fused_outputs, metas):
+    for vec, (dt, idxs, shapes, srcs) in zip(fused_outputs, metas):
         offset = 0
-        for i, shp in zip(idxs, shapes):
+        for i, shp, src in zip(idxs, shapes, srcs):
             sz = int(np.prod(shp)) if shp else 1
-            results[i] = vec[offset:offset + sz].reshape(shp)
+            piece = vec[offset:offset + sz].reshape(shp)
+            results[i] = piece if src == dt else piece.astype(src)
             offset += sz
     return results
 
@@ -718,32 +762,43 @@ def _negotiate_eager(kind: str, request_type: int, name: str | None,
                          postscale=postscale, splits_crc=splits_crc)
 
 
+def _request_dict(name: str, request_type: int, shape, dtype,
+                  group_id: int = -1, **meta) -> dict:
+    """ONE negotiation request in the engine's wire format — the single
+    source of truth shared by the sync path, the dispatch plans, and the
+    fusion-cycle queue (the engine cross-validates these fields across
+    processes, so every emitter must agree byte-for-byte)."""
+    dt = jnp.dtype(dtype)
+    return dict(name=name, request_type=request_type, dtype=_dtype_id(dt),
+                element_size=dt.itemsize,
+                shape=tuple(int(d) for d in shape), group_id=group_id,
+                **meta)
+
+
+def _group_requests(base: str, request_type: int, shapes_dtypes,
+                    **meta) -> list[dict]:
+    """The grouped negotiation payload: per-tensor requests named
+    ``{base}.{i}`` sharing a group id derived from the base (identical on
+    every process), which lets a joined rank reconstruct the group
+    boundary from the response stream (``_execute_joined_zeros``) and the
+    engine enforce joint fusion."""
+    import zlib
+    gid = zlib.crc32(base.encode()) & 0x7FFFFFFF
+    return [_request_dict(f"{base}.{i}", request_type, shape, dtype,
+                          group_id=gid, **meta)
+            for i, (shape, dtype) in enumerate(shapes_dtypes)]
+
+
 def _negotiate_eager_group(kind: str, request_type: int, name: str | None,
                            shapes_dtypes, pset: ProcessSet,
-                           root_rank: int = -1, reduce_op: int = -1,
-                           prescale: float = 1.0,
-                           postscale: float = 1.0) -> None:
-    """Batch variant for grouped ops: all members land in one cycle. The
-    shared group id (derived from the base name, identical everywhere)
-    lets a joined rank reconstruct the group boundary from the response
-    stream (``_execute_joined_zeros``)."""
-    import zlib
+                           **meta) -> None:
+    """Batch variant for grouped ops: all members land in one cycle."""
     from .. import engine_service
     svc = engine_service.get_service(pset)
     if svc is None:
         return
-    base = name or _auto_name(kind, pset)
-    gid = zlib.crc32(base.encode()) & 0x7FFFFFFF
-    reqs = []
-    for i, (shape, dtype) in enumerate(shapes_dtypes):
-        dt = jnp.dtype(dtype)
-        reqs.append(dict(name=f"{base}.{i}", request_type=request_type,
-                         dtype=_dtype_id(dt),
-                         element_size=dt.itemsize, shape=tuple(shape),
-                         root_rank=root_rank, group_id=gid,
-                         reduce_op=reduce_op, prescale=prescale,
-                         postscale=postscale))
-    svc.negotiate_many(reqs)
+    svc.negotiate_many(_group_requests(name or _auto_name(kind, pset),
+                                       request_type, shapes_dtypes, **meta))
 
 
 # ---------------------------------------------------------------------------
@@ -768,6 +823,18 @@ def _plan_sig(t):
         return ("r", tuple(shape), jnp.dtype(dtype).name)
     except TypeError:
         return None
+
+
+def _check_bundle_axis(sig, pset: ProcessSet) -> None:
+    """Plan-path twin of ``_as_bundle``'s leading-axis validation: a
+    PerRank bundle whose leading axis is not the process-set size must
+    raise the clear error, never silently drop/misroute rows (plans are
+    keyed by the bundle shape, so one check at build time covers every
+    hit)."""
+    if sig[0] == "b" and sig[1][0] != pset.size():
+        raise ValueError(
+            f"PerRank bundle leading axis {sig[1][0]} != process set "
+            f"size {pset.size()}")
 
 
 def _plan_negotiation(kind: str, request_type: int, name: str | None,
@@ -800,20 +867,12 @@ def _plan_group_negotiation(kind: str, request_type: int, name: str | None,
                             shapes_dtypes, pset: ProcessSet, **meta):
     """Grouped twin of :func:`_plan_negotiation`: the request batch is
     assembled once and replayed with stable names on every hit."""
-    import zlib
     from .. import engine_service
     svc = engine_service.get_service(pset)
     if svc is None:
         return None
-    base = name or _auto_name(kind, pset)
-    gid = zlib.crc32(base.encode()) & 0x7FFFFFFF
-    reqs = []
-    for i, (shape, dtype) in enumerate(shapes_dtypes):
-        dt = jnp.dtype(dtype)
-        reqs.append(dict(name=f"{base}.{i}", request_type=request_type,
-                         dtype=_dtype_id(dt), element_size=dt.itemsize,
-                         shape=tuple(int(d) for d in shape), group_id=gid,
-                         **meta))
+    reqs = _group_requests(name or _auto_name(kind, pset), request_type,
+                           shapes_dtypes, **meta)
 
     def negotiate():
         resps = svc.negotiate_many(reqs)
@@ -840,27 +899,17 @@ def _grouped_donate_mask(metas, alias_risk) -> tuple:
     bucket has a single member whose flatten is a no-op — jnp's reshape and
     single-array concatenate fast paths then hand back the caller's own
     array object, which must never be donated. ``alias_risk(i)`` says
-    whether member ``i``'s flatten can no-op onto a user-held array."""
+    whether member ``i``'s flatten can no-op onto a user-held array; a
+    wire-dtype cast (source dtype != bucket dtype) always produces a fresh
+    dispatcher-owned array, so those buckets stay donatable."""
     return tuple(
-        not (len(bidxs) == 1 and alias_risk(bidxs[0]))
-        for (_dt, bidxs, _shapes) in metas)
-
-
-def _fuse_flat(tensors):
-    """Replicated-strategy fusion: pack raw same-dtype arrays into flat
-    wire vectors (no leading rank axis — every rank contributes the same
-    values, so the program replicates via ``in_specs=P()``)."""
-    fused, metas = [], []
-    for dt, bidxs in _fusion_buckets(tensors, envs.fusion_threshold_bytes(),
-                                     lambda t: max(int(t.size), 1)):
-        flat = [tensors[i].reshape(-1) for i in bidxs]
-        fused.append(jnp.concatenate(flat) if len(flat) > 1 else flat[0])
-        metas.append((dt, bidxs, [tuple(tensors[i].shape) for i in bidxs]))
-    return fused, metas
+        not (len(bidxs) == 1 and srcs[0] == dt and alias_risk(bidxs[0]))
+        for (dt, bidxs, _shapes, srcs) in metas)
 
 
 def _build_allreduce_plan(sig, pset: ProcessSet, axis, op: ReduceOp,
                           pre_f: float, post_f: float, name: str | None):
+    _check_bundle_axis(sig, pset)
     lowered_op, post = handle_average(op, pset.size(), post_f)
     pre, post = float(pre_f), float(post)
     bundled = sig[0] == "b"
@@ -901,9 +950,10 @@ def _plan_fused_programs(metas, smap, n: int, count: int, bundled: bool,
     identity-reshape single-tensor buckets)."""
     if bundled:
         def fuse(*bundles):
-            return tuple(jnp.concatenate([bundles[i].reshape(n, -1)
+            return tuple(jnp.concatenate([bundles[i].astype(dt)
+                                          .reshape(n, -1)
                                           for i in bidxs], axis=1)
-                         for (_dt, bidxs, _s) in metas)
+                         for (dt, bidxs, _s, _src) in metas)
 
         def wire(*fused):
             outs = smap(*fused)
@@ -912,10 +962,11 @@ def _plan_fused_programs(metas, smap, n: int, count: int, bundled: bool,
             return tuple(_split_fused(list(outs), metas, count))
     else:
         def fuse(*arrs):
-            return tuple(jnp.concatenate([arrs[i].reshape(-1)
+            return tuple(jnp.concatenate([arrs[i].astype(dt).reshape(-1)
                                           for i in bidxs])
-                         if len(bidxs) > 1 else arrs[bidxs[0]].reshape(-1)
-                         for (_dt, bidxs, _s) in metas)
+                         if len(bidxs) > 1
+                         else arrs[bidxs[0]].astype(dt).reshape(-1)
+                         for (dt, bidxs, _s, _src) in metas)
 
         def wire(*fused):
             return tuple(_split_fused(list(smap(*fused)), metas, count))
@@ -927,23 +978,23 @@ def _plan_fused_programs(metas, smap, n: int, count: int, bundled: bool,
 
 def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
                                   op: ReduceOp, pre_f: float, post_f: float,
-                                  name: str | None):
+                                  name: str | None, compression=None):
+    for s in sigs:
+        _check_bundle_axis(s, pset)
     lowered_op, post = handle_average(op, pset.size(), post_f)
     pre, post = float(pre_f), float(post)
     n = pset.size()
     count = len(tensors)
     bundled = any(s[0] == "b" for s in sigs)
     shapes = [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
+    wire_dts = [_wire_dtype_of(t, compression) for t in tensors]
     hier = (lowered_op == ReduceOp.SUM
             and hierarchical.hierarchical_enabled_for(pset))
+    metas = _fusion_metas(shapes, [s[2] for s in sigs], wire_dts)
     if bundled:
-        first = [_bundle_of(t, shp, n) for t, shp in zip(tensors, shapes)]
-        _, metas = _fuse_by_dtype(first, n)
         donate = _grouped_donate_mask(
             metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
     else:
-        first = [jnp.asarray(t) for t in tensors]
-        _, metas = _fuse_flat(first)
         donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
     if hier:
         smap = hierarchical._hier_grouped_allreduce_smap(
@@ -961,12 +1012,14 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
     else:
         def execute(ts):
             return list(wire_fn(*fuse_fn(*[jnp.asarray(t) for t in ts])))
+    # Negotiation metadata carries the WIRE dtype — that is what peers
+    # must agree on (and what a joined rank's zero buffers reduce in).
     negotiate = _plan_group_negotiation(
         "grouped_allreduce", REQ_ALLREDUCE, name,
-        [(shp, jnp.dtype(s[2])) for shp, s in zip(shapes, sigs)], pset,
+        [(shp, dt) for shp, dt in zip(shapes, wire_dts)], pset,
         reduce_op=int(lowered_op), prescale=pre, postscale=post)
-    nbytes = sum(int(np.prod(shp) or 1) * jnp.dtype(s[2]).itemsize
-                 for shp, s in zip(shapes, sigs))
+    nbytes = sum(int(np.prod(shp) or 1) * dt.itemsize
+                 for shp, dt in zip(shapes, wire_dts))
     return _dispatch.DispatchPlan(name or "grouped_allreduce",
                                   "GROUPED_ALLREDUCE", nbytes, negotiate,
                                   execute)
@@ -974,6 +1027,7 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
 
 def _build_broadcast_plan(sig, pset: ProcessSet, axis, root_rank: int,
                           name: str | None):
+    _check_bundle_axis(sig, pset)
     bundled = sig[0] == "b"
     per_shape = sig[1][1:] if bundled else sig[1]
     dtype = jnp.dtype(sig[2])
@@ -995,19 +1049,19 @@ def _build_broadcast_plan(sig, pset: ProcessSet, axis, root_rank: int,
 
 def _build_grouped_broadcast_plan(tensors, sigs, pset: ProcessSet, axis,
                                   root_rank: int, name: str | None):
+    for s in sigs:
+        _check_bundle_axis(s, pset)
     n = pset.size()
     count = len(tensors)
     root_pos = pset.ranks.index(root_rank)
     bundled = any(s[0] == "b" for s in sigs)
     shapes = [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
+    src_dts = [jnp.dtype(s[2]) for s in sigs]
+    metas = _fusion_metas(shapes, src_dts, src_dts)
     if bundled:
-        first = [_bundle_of(t, shp, n) for t, shp in zip(tensors, shapes)]
-        _, metas = _fuse_by_dtype(first, n)
         donate = _grouped_donate_mask(
             metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
     else:
-        first = [jnp.asarray(t) for t in tensors]
-        _, metas = _fuse_flat(first)
         donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
     smap = _grouped_broadcast_smap(pset.mesh(), axis, root_pos, len(metas),
                                    bundled)
@@ -1033,10 +1087,14 @@ def _build_allgather_plan(sig, pset: ProcessSet, axis, name: str | None):
     """Uniform-shape eager allgather plan. Returns None when a negotiation
     service runs — the engine's recv_splits can resize the program per
     call (ragged peers / joined processes), so multi-process allgather
-    keeps the response-driven path."""
+    keeps the response-driven path. NOTE: ``allgather()`` already skips
+    plan lookup entirely when a service exists (per-call unique async
+    names would churn the cache with UNPLANNABLE entries), so this check
+    only guards the race of a service appearing between the two calls."""
     from .. import engine_service
     if engine_service.get_service(pset) is not None:
         return None
+    _check_bundle_axis(sig, pset)
     bundled = sig[0] == "b"
     per_shape = sig[1][1:] if bundled else sig[1]
     dtype = jnp.dtype(sig[2])
@@ -1153,14 +1211,19 @@ def _execute_allreduce_bundle(bundle, pset, axis, lowered_op, pre, post):
 def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
                       process_set: ProcessSet | None = None,
                       prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-                      name: str | None = None, axis_name=None):
+                      name: str | None = None, axis_name=None,
+                      compression=None):
     """Fused allreduce of a tensor list (reference ``grouped_allreduce``,
     ``EnqueueTensorAllreduces`` with a group at ``operations.cc:1384-1512``).
 
     Eager mode performs explicit tensor fusion: tensors are flattened and
-    concatenated per dtype into single wire buffers (the XLA analog of the
-    reference's fusion buffer, ``fusion_buffer_manager.h:30-50``), reduced
-    in one compiled program, then split back.
+    concatenated per WIRE dtype into single wire buffers (the XLA analog of
+    the reference's fusion buffer, ``fusion_buffer_manager.h:30-50``),
+    reduced in one compiled program, then split back. ``compression``
+    (``hvd.Compression.bf16``/``fp16``) routes floating tensors over the
+    wire in the compressed dtype: mixed-source-dtype tensors sharing a wire
+    dtype fuse into ONE buffer instead of fragmenting per source dtype, and
+    each result is cast back (decompressed) after the split.
     """
     if not tensors:
         return []
@@ -1171,6 +1234,29 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_allreduce
         return [adasum_allreduce(t, process_set=pset, axis_name=axis) for t in tensors]
+    if _is_custom_compressor(compression):
+        # user Compressor subclass: only its compress/decompress pair
+        # defines the wire format — wrap the call per leaf (the pre-wire-
+        # fusion contract), no wire-dtype bucketing. Compressors see
+        # arrays, so PerRank bundles are compressed through their array.
+        def _comp(t):
+            if isinstance(t, PerRank):
+                c, ctx = compression.compress(t.array)
+                return PerRank(c, t.dim0s), ctx
+            return compression.compress(t)
+
+        cs, ctxs = zip(*(_comp(t) for t in tensors))
+        outs = grouped_allreduce(
+            list(cs), op=op, process_set=pset,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, name=name, axis_name=axis)
+        return [compression.decompress(o, ctx)
+                for o, ctx in zip(outs, ctxs)]
+    # plan/queue identity of the wire mapping: the wire dtype itself (a
+    # class name would miss compressor instances and collide same-named
+    # user classes with different wire formats)
+    _wire = getattr(compression, "wire_dtype", None)
+    comp_key = jnp.dtype(_wire).name if _wire is not None else None
 
     if _compat.trace_state_clean():
         sigs = (tuple(_plan_sig(t) for t in tensors)
@@ -1180,16 +1266,28 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
                    pset.dispatch_key(), int(op), float(prescale_factor),
                    float(postscale_factor),
                    hierarchical.hierarchical_enabled_for(pset),
-                   envs.fusion_threshold_bytes())
+                   envs.fusion_threshold_bytes(), comp_key)
             plan = _dispatch.lookup(key)
             if plan is None:
                 plan = _build_grouped_allreduce_plan(
                     tensors, sigs, pset, axis, op, prescale_factor,
-                    postscale_factor, name)
+                    postscale_factor, name, compression)
                 _dispatch.store(key, plan)
             return plan.run(tensors)
     elif _axis_is_bound(axis):
         groups = pset.axis_index_groups()
+        if comp_key is not None:
+            # traced wire compression: cast, reduce, cast back per leaf
+            # (XLA fuses the casts into the collective's producers)
+            outs = []
+            for t in tensors:
+                wdt = _wire_dtype_of(t, compression)
+                src = jnp.result_type(t)
+                r = _allreduce_traced(t.astype(wdt) if src != wdt else t,
+                                      axis, op, prescale_factor,
+                                      postscale_factor, groups)
+                outs.append(r.astype(src) if src != wdt else r)
+            return outs
         traced_fusion = envs.get_int(envs.TRACED_FUSION_THRESHOLD, 0)
         if len(tensors) > 1 and traced_fusion > 0:
             return _grouped_allreduce_traced_fused(
@@ -1199,7 +1297,8 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
                                   postscale_factor, groups)
                 for t in tensors]
     elif any(_contains_tracer(t) for t in tensors):
-        # GSPMD passthrough (see allreduce above).
+        # GSPMD passthrough (see allreduce above). Nothing travels a wire,
+        # so compression is the identity here too.
         _gspmd_passthrough_check(op, "grouped_allreduce")
         scale = prescale_factor * postscale_factor
         return list(tensors) if scale == 1.0 else [t * scale for t in tensors]
@@ -1208,16 +1307,19 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
     # --- eager fusion path ---
     n = pset.size()
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
+    wire_dts = [_wire_dtype_of(b, compression) for b in bundles]
     _negotiate_eager_group("grouped_allreduce", REQ_ALLREDUCE, name,
-                           [(b.shape[1:], b.dtype) for b in bundles], pset,
+                           [(b.shape[1:], dt)
+                            for b, dt in zip(bundles, wire_dts)], pset,
                            reduce_op=int(lowered_op),
                            prescale=float(prescale_factor),
                            postscale=float(post))
-    _autotune.record(sum(b.nbytes // max(b.shape[0], 1) for b in bundles))
+    _autotune.record(sum(int(np.prod(b.shape[1:]) or 1) * dt.itemsize
+                         for b, dt in zip(bundles, wire_dts)))
     with _timeline.op_range(name or "grouped_allreduce", "GROUPED_ALLREDUCE"):
         return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
                                         float(prescale_factor), float(post),
-                                        len(tensors))
+                                        len(tensors), wire_dtypes=wire_dts)
 
 
 def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
@@ -1255,11 +1357,11 @@ def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
 
 
 def _execute_grouped_bundles(bundles, pset, axis, lowered_op, pre, post,
-                             count):
+                             count, wire_dtypes=None):
     """One fused eager grouped-allreduce program over (n, ...) bundles —
     shared by the caller path and the joined-rank zero path."""
     n = pset.size()
-    fused_inputs, metas = _fuse_by_dtype(bundles, n)
+    fused_inputs, metas = _fuse_by_dtype(bundles, n, wire_dtypes=wire_dtypes)
     # No donation here: this generic path doubles as the HVD_CACHE_CAPACITY=0
     # reference behavior; buffer donation lives in the dispatch plans' wire
     # programs (_plan_fused_programs), where the wire buffers are provably
@@ -1295,6 +1397,15 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,
     axis = _resolve_axis(axis_name)
     if _compat.trace_state_clean():
         sig = _plan_sig(tensor) if _dispatch.enabled() else None
+        if sig is not None:
+            from .. import engine_service
+            if engine_service.get_service(pset) is not None:
+                # Response-driven path: the engine's recv_splits can
+                # resize the program per call, so no plan can ever serve
+                # — and per-call unique names (async queue entries) would
+                # otherwise churn the cache with dead UNPLANNABLE keys,
+                # evicting live plans.
+                sig = None
         if sig is not None:
             key = ("allgather", name, sig, axis, pset.dispatch_key(),
                    hierarchical.hierarchical_allgather_enabled_for(pset))
@@ -1662,6 +1773,11 @@ def barrier(*, process_set: ProcessSet | None = None, axis_name=None):
     axis = _resolve_axis(axis_name)
     if _axis_is_bound(axis):
         return  # traced code is synchronous by construction
+    # Queued async work must land before the barrier: every process
+    # reaches this flush at the same program point, so the drain order is
+    # rank-deterministic.
+    from . import fusion_cycle
+    fusion_cycle.flush_all("barrier")
     _negotiate_eager("barrier", REQ_BARRIER, None, (), jnp.int32, pset)
     fn = _eager_allreduce_fn(pset.mesh(), axis, ReduceOp.SUM, 1.0, 1.0)
     jax.block_until_ready(fn(jnp.zeros((pset.size(), 1), jnp.int32)))
@@ -1807,6 +1923,10 @@ def join() -> int:
     if svc is None:
         barrier()
         return runtime.size() - 1
+    # A joining process first lands its own queued async work — after the
+    # JOIN is negotiated it may only contribute zeros.
+    from . import fusion_cycle
+    fusion_cycle.flush_all("join")
     name = _auto_name("join", pset)
     last_proc = svc.join(name)
     if last_proc < 0:
@@ -1817,45 +1937,195 @@ def join() -> int:
 
 
 # ---------------------------------------------------------------------------
-# async handles (reference torch mpi_ops.py:914-953 poll/synchronize)
+# async handles (reference torch mpi_ops.py:914-953 poll/synchronize) over
+# the cycle-driven fusion scheduler (ops/fusion_cycle.py): *_async calls
+# enqueue into per-signature pending queues and dispatch at the next flush
+# (threshold / cycle / synchronize / barrier), coalescing independently
+# submitted small tensors into one grouped wire program — the reference's
+# fusion-buffer cycle (operations.cc:385-806). HVD_CYCLE_TIME=0 restores
+# immediate per-call dispatch.
 # ---------------------------------------------------------------------------
 
 class Handle:
-    """Completion handle for *_async ops. JAX dispatch is already
-    asynchronous; the handle wraps the in-flight result."""
+    """Completion handle for *_async ops. The result may still be queued
+    in the fusion cycle (dispatched at the next flush) or already in
+    flight (JAX dispatch is itself asynchronous); ``synchronize`` flushes,
+    blocks, and is idempotent — repeated calls return the cached result
+    without re-walking the arrays."""
 
-    __slots__ = ("_result",)
+    __slots__ = ("_result", "_synced")
 
-    def __init__(self, result):
+    def __init__(self, result=None):
         self._result = result
+        self._synced = False
+
+    def _materialize(self):
+        """The dispatched result (queued subclass flushes first)."""
+        return self._result
+
+    def _dispatched(self) -> bool:
+        return True
 
     def poll(self) -> bool:
+        """True when the result landed. A still-queued handle first
+        triggers a flush of its own entry — without that, polling an
+        unflushed handle would spin forever waiting on a dispatch that
+        nothing else triggers. A handle whose flush FAILED (or was
+        aborted by a service reset) polls True — "synchronize() will not
+        block" — and the error surfaces there; poll itself never raises
+        (the reference's poll contract)."""
+        if self._synced:
+            return True
+        if not self._dispatched():
+            return False
+        try:
+            result = self._materialize()
+        except Exception:
+            return True  # completed in error; synchronize() raises it
         leaves = jax.tree.leaves(
-            self._result.array if isinstance(self._result, PerRank) else self._result)
+            result.array if isinstance(result, PerRank) else result)
         return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
 
     def synchronize(self):
+        if self._synced:
+            return self._result
+        result = self._materialize()
         jax.block_until_ready(
-            self._result.array if isinstance(self._result, PerRank) else self._result)
+            result.array if isinstance(result, PerRank) else result)
+        self._result = result
+        self._synced = True
         return self._result
 
 
-def allreduce_async(tensor, **kw) -> Handle:
+class _QueuedHandle(Handle):
+    """Handle over a fusion-cycle queue entry (futures-style): the op has
+    not dispatched yet; poll/synchronize flush the entry's queue."""
+
+    __slots__ = ("_entry",)
+
+    def __init__(self, entry):
+        super().__init__(None)
+        self._entry = entry
+
+    def _dispatched(self) -> bool:
+        from . import fusion_cycle
+        return fusion_cycle.scheduler().poll_entry(self._entry)
+
+    def _materialize(self):
+        from . import fusion_cycle
+        results = fusion_cycle.scheduler().wait_result(self._entry)
+        return list(results) if self._entry.grouped else results[0]
+
+
+def _is_custom_compressor(compression) -> bool:
+    """A user Compressor subclass with its own compress/decompress pair
+    but no cast-style ``wire_dtype`` — only it knows the wire format, so
+    it must wrap the call instead of routing through wire-dtype fusion."""
+    from .compression import NoneCompressor
+    return (compression is not None
+            and getattr(compression, "wire_dtype", None) is None
+            and hasattr(compression, "compress")
+            and compression is not NoneCompressor)
+
+
+def allreduce_async(tensor, *, compression=None, **kw) -> Handle:
+    """Async allreduce (reference ``allreduce_async_``,
+    ``torch/mpi_ops.py:124``): enqueues into the fusion cycle and returns
+    immediately; the collective dispatches at the next flush, fused with
+    other pending same-signature submissions. ``compression`` routes the
+    tensor over the wire in the compressed dtype (decompressed on
+    synchronize)."""
+    from . import fusion_cycle
+    h = fusion_cycle.queue_allreduce([tensor], grouped=False,
+                                     compression=compression, **kw)
+    if h is not None:
+        return h
+    if _is_custom_compressor(compression) \
+            or getattr(compression, "wire_dtype", None) is not None:
+        return Handle(grouped_allreduce([tensor], compression=compression,
+                                        **kw)[0])
     return Handle(allreduce(tensor, **kw))
 
 
-def grouped_allreduce_async(tensors, **kw) -> Handle:
+def grouped_allreduce_async(tensors, *, compression=None, **kw) -> Handle:
     """Handle over a fused grouped allreduce (reference
-    ``grouped_allreduce_async``, ``torch/mpi_ops.py:375``)."""
-    return Handle(grouped_allreduce(tensors, **kw))
+    ``grouped_allreduce_async``, ``torch/mpi_ops.py:375``). The group
+    rides the fusion cycle atomically (never split across flushes) and
+    may fuse further with other pending same-signature submissions."""
+    if not tensors:
+        return Handle([])
+    from . import fusion_cycle
+    h = fusion_cycle.queue_allreduce(list(tensors), grouped=True,
+                                     compression=compression, **kw)
+    if h is not None:
+        return h
+    return Handle(grouped_allreduce(tensors, compression=compression, **kw))
 
 
 def allgather_async(tensor, **kw) -> Handle:
+    from . import fusion_cycle
+    h = fusion_cycle.queue_allgather(tensor, **kw)
+    if h is not None:
+        return h
     return Handle(allgather(tensor, **kw))
 
 
 def broadcast_async(tensor, root_rank, **kw) -> Handle:
+    from . import fusion_cycle
+    h = fusion_cycle.queue_broadcast(tensor, root_rank, **kw)
+    if h is not None:
+        return h
     return Handle(broadcast(tensor, root_rank, **kw))
+
+
+def grouped_broadcast_async(tensors, root_rank, *, process_set=None,
+                            name=None, axis_name=None) -> Handle:
+    """Handle over a fused broadcast of a tensor list: every leaf rides
+    the broadcast queue (one entry per tensor, so independently-submitted
+    broadcasts of the same root coalesce too); ``broadcast_parameters``
+    synchronizes a whole model through one flush."""
+    from . import fusion_cycle
+    handles = []
+    for i, t in enumerate(tensors):
+        h = fusion_cycle.queue_broadcast(
+            t, root_rank, process_set=process_set,
+            name=None if name is None else f"{name}.{i}",
+            axis_name=axis_name)
+        if h is None:
+            break
+        handles.append(h)
+    if len(handles) == len(tensors):
+        return _MultiHandle(handles)
+    # scheduler off / unplannable leaf: drain the queued prefix (keeps
+    # submission order), then broadcast only the remaining tensors under
+    # a distinct name base — reusing `name` would renegotiate the
+    # prefix's "{name}.0..." names with the remainder's metadata
+    prefix = [h.synchronize() for h in handles]
+    rest = grouped_broadcast(tensors[len(handles):], root_rank,
+                             process_set=process_set,
+                             name=None if name is None else f"{name}.rest",
+                             axis_name=axis_name)
+    return Handle(prefix + rest)
+
+
+class _MultiHandle(Handle):
+    """Aggregate handle over per-tensor queued handles (grouped
+    broadcast): synchronizes all, returns the result list."""
+
+    __slots__ = ("_handles",)
+
+    def __init__(self, handles):
+        super().__init__(None)
+        self._handles = handles
+
+    def _dispatched(self) -> bool:
+        return all(h._dispatched() for h in self._handles)
+
+    def _materialize(self):
+        # sub-handles' _materialize waits only on the dispatch event (no
+        # device block) — poll() must stay non-blocking; synchronize()
+        # adds the block_until_ready over the whole list in Handle
+        return [h._materialize() for h in self._handles]
 
 
 def alltoall_async(tensor, splits=None, **kw) -> Handle:
@@ -1868,6 +2138,57 @@ def poll(handle: Handle) -> bool:
 
 def synchronize(handle: Handle):
     return handle.synchronize()
+
+
+# -- queued-entry executors (multi-process flush path: negotiation already
+#    batched by the scheduler, program composition = submission-time) -------
+
+def _run_queued_allreduce(tensors, pset: ProcessSet, axis, op: ReduceOp,
+                          pre_f: float, post_f: float, compression,
+                          label: str) -> list:
+    """Execute one queued allreduce entry (single tensor or atomic group)
+    with its submission-time composition — the same program shape a joined
+    rank reconstructs from response metadata (``_execute_joined_zeros``),
+    so active and joined processes always lower identical SPMD programs."""
+    lowered_op, post = handle_average(op, pset.size(), post_f)
+    pre, post = float(pre_f), float(post)
+    bundles = [_as_bundle(t, pset)[0] for t in tensors]
+    wire_dts = [_wire_dtype_of(b, compression) for b in bundles]
+    _autotune.record(sum(int(np.prod(b.shape[1:]) or 1) * dt.itemsize
+                         for b, dt in zip(bundles, wire_dts)))
+    with _timeline.op_range(label, "ALLREDUCE" if len(tensors) == 1
+                            else "GROUPED_ALLREDUCE"):
+        if len(bundles) == 1:
+            # single entry: the un-fused program, the exact shape a joined
+            # rank rebuilds from the response (wire-dtype zeros, gid=-1)
+            b, src = bundles[0], bundles[0].dtype
+            if wire_dts[0] != src:
+                b = b.astype(wire_dts[0])
+            out = _execute_allreduce_bundle(b, pset, axis, lowered_op,
+                                            pre, post)
+            return [out.astype(src) if wire_dts[0] != src else out]
+        return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
+                                        pre, post, len(tensors),
+                                        wire_dtypes=wire_dts)
+
+
+def _run_queued_broadcast(tensors, pset: ProcessSet, axis, root_rank: int,
+                          label: str) -> list:
+    """Execute one queued broadcast entry (submission-time composition;
+    see :func:`_run_queued_allreduce`)."""
+    n = pset.size()
+    root_pos = pset.ranks.index(root_rank)
+    bundles = [_as_bundle(t, pset)[0] for t in tensors]
+    _autotune.record(sum(b.nbytes // max(b.shape[0], 1) for b in bundles))
+    with _timeline.op_range(label, "BROADCAST" if len(tensors) == 1
+                            else "GROUPED_BROADCAST"):
+        if len(bundles) == 1:
+            return [_eager_broadcast_fn(pset.mesh(), axis,
+                                        root_pos)(bundles[0])]
+        fused_inputs, metas = _fuse_by_dtype(bundles, n)
+        fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
+                                         len(fused_inputs))
+        return _split_fused(fn(*fused_inputs), metas, len(tensors))
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,14 @@ changes plan contents).
 Statistics surface through :func:`stats` (exported as
 ``hvd.dispatch_cache_stats()``) and, when a timeline is recording, as
 instant ``PLAN_HIT``/``PLAN_MISS`` events per op lane.
+
+The cache has two clients: direct eager calls, and the cycle-driven
+fusion scheduler (``ops/fusion_cycle.py``), whose single-controller
+flushes coalesce a pending queue into one ``grouped_allreduce`` /
+``grouped_broadcast`` — a steady-state training loop's flush signature
+repeats every step, so the coalesced dispatch is a plan HIT straight into
+the compiled fuse+wire programs (this pairing is what makes the cycle
+flush cheap enough to sit on the async hot path).
 """
 
 from __future__ import annotations

@@ -1,0 +1,802 @@
+"""Cycle-driven cross-call fusion scheduler for eager async collectives.
+
+The TPU-native rebuild of the reference's headline performance mechanism:
+not the collective itself but the background cycle that coalesces
+independently-submitted small tensors into large fusion buffers
+(``operations.cc:385-806``: the coordinator negotiates readiness, fuses
+ready tensors into buffers bounded by ``HOROVOD_FUSION_THRESHOLD``, and
+flushes every ``HOROVOD_CYCLE_TIME``). Before this module, every
+``*_async`` call dispatched its own collective synchronously — a
+per-parameter eager loop over 100 small gradients paid 100 negotiations
+and 100 wire launches.
+
+Here, ``allreduce_async`` / ``broadcast_async`` / ``allgather_async`` /
+``grouped_allreduce_async`` / ``sparse_allreduce_async`` enqueue into
+**per-signature pending queues** instead of dispatching immediately. A
+queue is keyed like the dispatch plan cache: op kind / process set /
+reduce op / pre+post scales / hierarchical flag / wire dtype (the
+compression class), so everything in one queue is legal to fuse into one
+grouped dispatch. A flush fires when
+
+* pending bytes in a queue reach ``HVD_FUSION_THRESHOLD`` (trigger
+  ``threshold``),
+* ``HVD_CYCLE_TIME`` elapses on the queue's oldest entry — or
+  ``HVD_PENDING_CYCLE_TIME`` while work is in flight (trigger ``cycle``;
+  a dispatch keeps the scheduler "in flight" for one cycle window),
+* total pending bytes across all queues exceed ``HVD_FUSION_MAX_PENDING``
+  (backpressure; trigger ``backpressure``),
+* the user observes a handle: ``Handle.poll()`` / ``Handle.synchronize()``
+  (triggers ``poll`` / ``synchronize``), or
+* a synchronization point drains everything: ``hvd.barrier()`` (trigger
+  ``barrier``) or ``hvd.shutdown()`` (trigger ``shutdown``).
+
+A flush coalesces the queue into ONE grouped dispatch through the
+existing dispatch plan cache (``ops/dispatch_cache.py``) — steady-state
+training loops therefore pay one plan hit per flush instead of one full
+dispatch per parameter.
+
+Determinism contract (the reference coordinator's role): flush
+*composition* must be identical on every rank. Composition derives from
+submission order and deterministic negotiation names only — never from
+wall-clock:
+
+* **Single-controller jobs** (one process drives every chip — the normal
+  SPMD deployment): the one process's queue IS the global view, so any
+  flush trigger yields a rank-consistent composition by construction.
+* **Multi-process jobs** (a negotiation service is running): each entry
+  is assigned a deterministic negotiation name at *submission* time
+  (per-set counters, identical across processes running the same
+  program). A flush batches the drained entries' negotiations into one
+  ``negotiate_many`` round (one KV cycle for the whole flush — the
+  queue's multi-process win) but keeps each entry's *program composition*
+  exactly as submitted: singles stay single programs, grouped entries
+  stay their group. That mirrors the active-path programs a joined rank
+  reconstructs from response metadata (``_execute_joined_zeros``), so
+  composition can never diverge across processes — timer jitter on one
+  process only changes *when* entries negotiate, never *what* program
+  runs.
+
+Statistics surface through :func:`stats` (exported as
+``hvd.fusion_stats()``); the timeline gains ``QUEUE_ENQUEUE`` and
+``CYCLE_FLUSH`` instant events. The scheduler's off switch is
+``HVD_CYCLE_TIME=0`` (immediate dispatch, the pre-queue behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autotune as _autotune
+from .. import timeline as _timeline
+from ..utils import envs
+from ..utils import logging as hvd_logging
+
+FLUSH_TRIGGERS = ("threshold", "cycle", "synchronize", "poll", "barrier",
+                  "join", "shutdown", "backpressure", "name-reuse")
+
+# In-flight window multiplier: after a dispatch the scheduler flushes at
+# the PENDING_CYCLE_TIME pace for one cycle window (see _age_limit_s).
+_INFLIGHT_WINDOW_CYCLES = 1.0
+
+
+def enabled() -> bool:
+    """The scheduler queues async ops whenever ``HVD_CYCLE_TIME`` > 0.
+    ``HVD_CYCLE_TIME=0`` restores immediate per-call dispatch (the
+    reference's cycle likewise stops coalescing at a zero cycle time)."""
+    return envs.cycle_time_ms() > 0.0
+
+
+def max_pending_bytes() -> int:
+    """Backpressure cap on total queued bytes across all queues
+    (``HVD_FUSION_MAX_PENDING``; default 4x the fusion threshold)."""
+    return envs.get_int(envs.FUSION_MAX_PENDING,
+                        4 * envs.fusion_threshold_bytes())
+
+
+def pending_cycle_time_ms() -> float:
+    """Flush pace while work is in flight (``HVD_PENDING_CYCLE_TIME``;
+    default: half the cycle time, capped at 2 ms like the engine
+    service's transport floor)."""
+    cycle = envs.cycle_time_ms()
+    return envs.get_float(envs.PENDING_CYCLE_TIME, min(cycle / 2.0, 2.0))
+
+
+class _QueueSpec:
+    """Immutable per-queue dispatch parameters, captured at first
+    enqueue. ``kind`` is one of allreduce/broadcast/allgather/sparse."""
+
+    __slots__ = ("kind", "pset", "axis", "op", "pre", "post", "root_rank",
+                 "compression", "svc")
+
+    def __init__(self, kind, pset, axis, op=None, pre=1.0, post=1.0,
+                 root_rank=-1, compression=None, svc=None):
+        self.kind = kind
+        self.pset = pset
+        self.axis = axis
+        self.op = op
+        self.pre = pre
+        self.post = post
+        self.root_rank = root_rank
+        self.compression = compression
+        self.svc = svc
+
+
+class _Entry:
+    """One queued ``*_async`` submission: a single tensor or an atomic
+    group (grouped entries never split across flushes). ``requests`` are
+    the pre-built negotiation dicts (multi-process jobs only; names
+    assigned at submission time so every process generates the same
+    sequence). ``run`` is the opaque executor for sparse entries."""
+
+    __slots__ = ("tensors", "count", "grouped", "nbytes", "names",
+                 "requests", "run", "queue_key", "label", "event",
+                 "results", "error")
+
+    def __init__(self, tensors, grouped, nbytes, names, requests=(),
+                 run=None, label=""):
+        self.tensors = tensors
+        self.count = len(tensors)
+        self.grouped = grouped
+        self.nbytes = nbytes
+        self.names = tuple(names)
+        self.requests = tuple(requests)
+        self.run = run
+        self.queue_key = None
+        self.label = label or (names[0] if names else "queued")
+        self.event = threading.Event()
+        self.results = None
+        self.error = None
+
+    @property
+    def done(self) -> bool:
+        return self.event.is_set()
+
+
+class _Queue:
+    __slots__ = ("spec", "entries", "nbytes", "oldest_t", "names")
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.entries: list[_Entry] = []
+        self.nbytes = 0
+        self.oldest_t = 0.0
+        self.names: set = set()  # pending negotiation names (O(1) clash check)
+
+
+class FusionScheduler:
+    """Owns the pending queues, the cycle timer thread, and the flush
+    statistics. Normally a process-wide singleton (:func:`scheduler`);
+    tests instantiate fresh ones to check composition determinism."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._queues: "OrderedDict[tuple, _Queue]" = OrderedDict()
+        self._pending_tensors = 0
+        self._pending_bytes = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._inflight_until = 0.0
+        self._stats = {
+            "enqueued_tensors": 0,
+            "enqueued_bytes": 0,
+            "flushed_tensors": 0,
+            "flushed_bytes": 0,
+            "dispatches": 0,
+            "flushes": {t: 0 for t in FLUSH_TRIGGERS},
+        }
+        # (trigger, queue key, entry names) per flush — the composition
+        # record the determinism tests compare across schedulers.
+        self.flush_history: deque = deque(maxlen=64)
+
+    # -- enqueue -----------------------------------------------------------
+
+    def enqueue(self, key: tuple, spec: _QueueSpec, entry: _Entry) -> None:
+        entry.queue_key = key
+        if entry.requests:
+            # Multi-process entries negotiate the whole flush in ONE
+            # negotiate_many batch, whose duplicate-name guard only spans
+            # batches — a user-named submission repeating a name already
+            # pending in the same queue would silently orphan the first
+            # request and stall the flush. Flush the queue first so the
+            # two negotiations stay sequential, like immediate dispatch.
+            with self._mu:
+                q = self._queues.get(key)
+                clash = q is not None and not q.names.isdisjoint(entry.names)
+            if clash:
+                self.flush_queue(key, "name-reuse")
+        with self._mu:
+            q = self._queues.get(key)
+            if q is None:
+                q = _Queue(spec)
+                q.oldest_t = time.monotonic()
+                self._queues[key] = q
+            q.entries.append(entry)
+            q.names.update(entry.names)
+            q.nbytes += entry.nbytes
+            self._pending_tensors += entry.count
+            self._pending_bytes += entry.nbytes
+            self._stats["enqueued_tensors"] += entry.count
+            self._stats["enqueued_bytes"] += entry.nbytes
+            over_threshold = q.nbytes >= envs.fusion_threshold_bytes()
+            over_pending = self._pending_bytes >= max_pending_bytes()
+            self._ensure_thread_locked()
+        for name in entry.names:
+            _timeline.record_queue_enqueue(name or entry.label)
+        self._wake.set()
+        if over_pending:
+            # Backpressure: drain everything oldest-first so memory held
+            # by pending wire payloads stays bounded.
+            self.flush_all("backpressure")
+        elif over_threshold:
+            self.flush_queue(key, "threshold")
+
+    # -- flushing ----------------------------------------------------------
+
+    def flush_queue(self, key: tuple, trigger: str) -> None:
+        """Flush one queue (no-op when it is already drained/being
+        flushed by another thread — the entry events carry completion)."""
+        with self._mu:
+            q = self._queues.pop(key, None)
+            if q is None or not q.entries:
+                return
+            entries = q.entries
+            self._pending_tensors -= sum(e.count for e in entries)
+            self._pending_bytes -= q.nbytes
+            self._stats["flushes"][trigger] += 1
+            self._stats["flushed_tensors"] += sum(e.count for e in entries)
+            self._stats["flushed_bytes"] += q.nbytes
+            self.flush_history.append(
+                (trigger, key, tuple(n for e in entries for n in e.names)))
+            self._inflight_until = time.monotonic() + (
+                _INFLIGHT_WINDOW_CYCLES * envs.cycle_time_ms() / 1e3)
+        _timeline.record_cycle_flush(trigger)
+        self._execute(q.spec, entries)
+
+    def flush_entry(self, entry: _Entry, trigger: str) -> None:
+        if not entry.done and entry.queue_key is not None:
+            self.flush_queue(entry.queue_key, trigger)
+
+    def flush_all(self, trigger: str) -> None:
+        """Drain every queue in first-enqueue order (barrier / shutdown /
+        backpressure)."""
+        while True:
+            with self._mu:
+                key = next(iter(self._queues), None)
+            if key is None:
+                return
+            self.flush_queue(key, trigger)
+
+    def wait_result(self, entry: _Entry):
+        """Synchronize path: flush the entry's queue if still pending,
+        wait for its dispatch, re-raise any flush failure."""
+        self.flush_entry(entry, "synchronize")
+        entry.event.wait()
+        if entry.error is not None:
+            raise entry.error
+        return entry.results
+
+    def poll_entry(self, entry: _Entry) -> bool:
+        """Poll path: an unflushed entry must first trigger its own flush
+        (otherwise ``poll()`` on a queued handle would spin forever), then
+        report whether the dispatch has landed."""
+        self.flush_entry(entry, "poll")
+        return entry.done
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, spec: _QueueSpec, entries: list[_Entry]) -> None:
+        try:
+            if spec.kind == "sparse":
+                self._execute_opaque(entries)
+            elif spec.kind == "allgather":
+                self._execute_allgather(spec, entries)
+            elif spec.svc is None:
+                self._execute_fused(spec, entries)
+            else:
+                self._execute_negotiated(spec, entries)
+        except BaseException as exc:
+            # Mark every undelivered entry so waiters unblock (the error
+            # re-raises at synchronize()).
+            for e in entries:
+                if not e.done:
+                    e.error = exc
+                    e.tensors = ()
+                    e.run = None
+                    e.event.set()
+            hvd_logging.error("fusion cycle flush failed: %s", exc)
+            if not isinstance(exc, Exception):
+                # KeyboardInterrupt/SystemExit must interrupt the caller
+                # (user-thread flushes run inside enqueue/synchronize);
+                # the timer loop catches it separately and survives.
+                raise
+
+    def _count_dispatch(self, n: int = 1) -> None:
+        with self._mu:
+            self._stats["dispatches"] += n
+
+    def _execute_fused(self, spec: _QueueSpec, entries: list[_Entry]) -> None:
+        """Single-controller flush: ONE grouped dispatch for the whole
+        queue, through the dispatch plan cache — repeated flush signatures
+        go straight to the compiled fused program."""
+        from . import collectives as _coll
+        tensors = [t for e in entries for t in e.tensors]
+        if spec.kind == "allreduce":
+            outs = _coll.grouped_allreduce(
+                tensors, op=spec.op, process_set=spec.pset,
+                prescale_factor=spec.pre, postscale_factor=spec.post,
+                axis_name=spec.axis, compression=spec.compression)
+        else:  # broadcast
+            outs = _coll.grouped_broadcast(
+                tensors, spec.root_rank, process_set=spec.pset,
+                axis_name=spec.axis)
+        self._count_dispatch()
+        i = 0
+        for e in entries:
+            e.results = list(outs[i:i + e.count])
+            i += e.count
+            e.tensors = ()  # release the inputs: handles keep results only
+            e.event.set()
+
+    def _execute_negotiated(self, spec: _QueueSpec,
+                            entries: list[_Entry]) -> None:
+        """Multi-process flush: batch ALL drained negotiations into one
+        ``negotiate_many`` round (one KV cycle per flush instead of one
+        per call), then execute each entry with its submission-time
+        program composition — identical to what a joined rank rebuilds
+        from response metadata, so programs match across processes no
+        matter when each process's cycle fired."""
+        from . import collectives as _coll
+        spec.svc.negotiate_many([r for e in entries for r in e.requests])
+        if spec.kind == "broadcast":
+            # Broadcast is illegal while any rank is joined (reference
+            # JoinOp covers allreduce/allgather/barrier only), so there is
+            # no joined-rank program reconstruction to match — the whole
+            # flushed queue fuses into one dispatch, like single-
+            # controller mode (flush points are rank-deterministic, so
+            # every process fuses the identical set).
+            tensors = [t for e in entries for t in e.tensors]
+            outs = _coll._run_queued_broadcast(
+                tensors, spec.pset, spec.axis, spec.root_rank,
+                entries[0].label)
+            self._count_dispatch()
+            i = 0
+            for e in entries:
+                e.results = list(outs[i:i + e.count])
+                i += e.count
+                e.tensors = ()
+                e.event.set()
+            return
+        for e in entries:
+            e.results = _coll._run_queued_allreduce(
+                e.tensors, spec.pset, spec.axis, spec.op, spec.pre,
+                spec.post, spec.compression, e.label)
+            self._count_dispatch()
+            e.tensors = ()
+            e.event.set()
+
+    def _execute_allgather(self, spec: _QueueSpec,
+                           entries: list[_Entry]) -> None:
+        """Allgather entries dispatch per-entry in submission order (the
+        engine's recv_splits can resize the program per call, so there is
+        no fused multi-tensor gather program to coalesce into); the queue
+        still defers them to the cycle so they overlap submission-side
+        Python with in-flight device work."""
+        from . import collectives as _coll
+        for e in entries:
+            e.results = [_coll.allgather(e.tensors[0], process_set=spec.pset,
+                                         axis_name=spec.axis,
+                                         name=e.names[0])]
+            self._count_dispatch()
+            e.tensors = ()
+            e.event.set()
+
+    def _execute_opaque(self, entries: list[_Entry]) -> None:
+        for e in entries:
+            e.results = [e.run()]
+            self._count_dispatch()
+            e.tensors = ()
+            e.run = None  # the closure holds the input rows
+            e.event.set()
+
+    # -- cycle timer -------------------------------------------------------
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="hvd-fusion-cycle")
+            self._thread.start()
+
+    def _age_limit_s(self) -> float:
+        """Queue age that triggers a cycle flush: CYCLE_TIME idle,
+        PENDING_CYCLE_TIME while work is in flight (a dispatch happened
+        within the last cycle window)."""
+        cycle = envs.cycle_time_ms() / 1e3
+        if time.monotonic() < self._inflight_until:
+            return min(cycle, pending_cycle_time_ms() / 1e3)
+        return cycle
+
+    def _loop(self) -> None:
+        stop = self._stop
+        while not stop.is_set():
+            self._wake.clear()
+            now = time.monotonic()
+            due: list[tuple] = []
+            next_deadline = None
+            with self._mu:
+                limit = self._age_limit_s()
+                for key, q in self._queues.items():
+                    if q.spec.svc is not None:
+                        # Multi-process queues NEVER flush from the timer:
+                        # XLA programs must be issued in the identical
+                        # order on every process, and only user-thread
+                        # triggers (threshold at enqueue, synchronize,
+                        # poll, barrier, shutdown) happen at rank-
+                        # deterministic program points. Timer jitter on
+                        # one process must not reorder dispatches.
+                        continue
+                    deadline = q.oldest_t + limit
+                    if deadline <= now:
+                        due.append(key)
+                    elif next_deadline is None or deadline < next_deadline:
+                        next_deadline = deadline
+            for key in due:
+                if stop.is_set():
+                    return
+                try:
+                    self.flush_queue(key, "cycle")
+                except BaseException:  # entries already marked failed; a
+                    # KeyboardInterrupt on the daemon timer is spurious
+                    # and must not kill the cycle loop
+                    hvd_logging.exception("cycle flush failed on timer")
+            if due:
+                continue
+            timeout = (None if next_deadline is None
+                       else max(next_deadline - time.monotonic(), 0.0))
+            self._wake.wait(timeout)
+
+    # -- lifecycle / stats -------------------------------------------------
+
+    def drain(self) -> None:
+        """Execute everything still pending (clean shutdown: results of
+        never-synchronized handles are materialized, not dropped)."""
+        self.flush_all("shutdown")
+
+    def abort(self, reason: str) -> int:
+        """Fail everything still pending without executing (engine
+        service reset / elastic world teardown — the world the entries
+        were negotiated against no longer exists). Returns the number of
+        entries aborted; their handles raise at synchronize()."""
+        with self._mu:
+            queues = list(self._queues.values())
+            self._queues.clear()
+            self._pending_tensors = 0
+            self._pending_bytes = 0
+        n = 0
+        for q in queues:
+            for e in q.entries:
+                e.error = RuntimeError(
+                    f"queued collective {e.label!r} aborted: {reason}")
+                e.tensors = ()
+                e.run = None
+                e.event.set()
+                n += 1
+        return n
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._thread = None
+
+    def stats(self) -> dict:
+        with self._mu:
+            flushes = dict(self._stats["flushes"])
+            dispatches = self._stats["dispatches"]
+            flushed = self._stats["flushed_tensors"]
+            total_flushes = sum(flushes.values())
+            return {
+                "enabled": enabled(),
+                "cycle_time_ms": envs.cycle_time_ms(),
+                "pending_cycle_time_ms": pending_cycle_time_ms(),
+                "fusion_threshold_bytes": envs.fusion_threshold_bytes(),
+                "max_pending_bytes": max_pending_bytes(),
+                "enqueued_tensors": self._stats["enqueued_tensors"],
+                "enqueued_bytes": self._stats["enqueued_bytes"],
+                "pending_tensors": self._pending_tensors,
+                "pending_bytes": self._pending_bytes,
+                "flushes": {**flushes, "total": total_flushes},
+                "flushed_tensors": flushed,
+                "flushed_bytes": self._stats["flushed_bytes"],
+                "dispatches": dispatches,
+                "tensors_per_flush": (flushed / total_flushes
+                                      if total_flushes else 0.0),
+                "bytes_per_flush": (self._stats["flushed_bytes"]
+                                    / total_flushes if total_flushes
+                                    else 0.0),
+                # tensors coalesced per wire dispatch — the headline
+                # number: N small async calls -> N/coalesce dispatches
+                "coalesce_ratio": (flushed / dispatches if dispatches
+                                   else 0.0),
+            }
+
+    def reset_stats(self) -> None:
+        with self._mu:
+            self._stats = {
+                "enqueued_tensors": 0, "enqueued_bytes": 0,
+                "flushed_tensors": 0, "flushed_bytes": 0, "dispatches": 0,
+                "flushes": {t: 0 for t in FLUSH_TRIGGERS},
+            }
+            self.flush_history.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-wide scheduler + the enqueue front door the async ops call
+# ---------------------------------------------------------------------------
+
+_scheduler: FusionScheduler | None = None
+_scheduler_lock = threading.Lock()
+
+
+def scheduler() -> FusionScheduler:
+    global _scheduler
+    if _scheduler is None:
+        with _scheduler_lock:
+            if _scheduler is None:
+                _scheduler = FusionScheduler()
+    return _scheduler
+
+
+def _plan_sigs(tensors):
+    """Per-tensor dispatch signatures, or None when any tensor cannot be
+    planned (python scalars, lists, ragged bundles keep the immediate
+    generic path). Computed ONCE per submission — the enqueue hot path is
+    exactly the per-call Python overhead this module exists to shrink."""
+    from . import collectives as _coll
+    sigs = [_coll._plan_sig(t) for t in tensors]
+    return sigs if all(s is not None for s in sigs) else None
+
+
+def _per_shapes(sigs):
+    """Per-rank shapes from signatures (bundles drop the rank axis)."""
+    return [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
+
+
+def _entry_nbytes(shapes, wire_dts) -> int:
+    """Per-rank wire payload of one entry (what lands in a fusion
+    buffer), in the wire dtype when compression is routed."""
+    return sum(int(np.prod(shp) or 1) * dt.itemsize
+               for shp, dt in zip(shapes, wire_dts))
+
+
+def _negotiation_requests(request_type, names, shapes, wire_dts,
+                          group_id=-1, **meta) -> list[dict]:
+    """Pre-built negotiation payloads (multi-process jobs): metadata is
+    frozen at submission time so every process emits the identical
+    request sequence regardless of when its cycle fires. Built through
+    ``collectives._request_dict``, the wire format's single owner."""
+    from . import collectives as _coll
+    return [_coll._request_dict(name, request_type, shape, dt,
+                                group_id=group_id, **meta)
+            for name, shape, dt in zip(names, shapes, wire_dts)]
+
+
+def queue_allreduce(tensors, *, grouped: bool, op=None, process_set=None,
+                    prescale_factor=1.0, postscale_factor=1.0, name=None,
+                    axis_name=None, compression=None):
+    """Enqueue an async (grouped) allreduce; returns a queued Handle, or
+    None when the submission must take the immediate path (scheduler off,
+    traced context, unplannable input, adasum, custom compressor)."""
+    from ..process_sets import _resolve
+    from ..utils import compat as _compat
+    from . import collectives as _coll
+    from .reduce_ops import ReduceOp, handle_average
+
+    if op is None:
+        op = ReduceOp.AVERAGE  # the allreduce()/reference default
+    if not tensors or not enabled() or not _compat.trace_state_clean():
+        return None
+    if op == ReduceOp.ADASUM:
+        return None
+    sigs = _plan_sigs(tensors)
+    if sigs is None:
+        return None
+    if _coll._is_custom_compressor(compression):
+        # custom (non-cast) compressor: only its own compress/decompress
+        # pair defines the wire format — take the immediate path, which
+        # wraps the call with it
+        return None
+    if getattr(compression, "wire_dtype", None) is None:
+        compression = None  # none-compression == no compression: one queue
+    pset = _resolve(process_set)
+    axis = _coll._resolve_axis(axis_name)
+    for t in tensors:
+        _coll._check_op_dtype(
+            op, jnp.result_type(t.array if isinstance(t, _coll.PerRank)
+                                else t))
+    from .. import engine_service
+    from . import hierarchical
+    svc = engine_service.get_service(pset)
+    # Key the queue by the WIRE mapping itself, not the compressor's
+    # class name — a compressor instance (or two classes sharing a name)
+    # must never share a queue with a different wire format.
+    wire = getattr(compression, "wire_dtype", None)
+    comp_key = jnp.dtype(wire).name if wire is not None else None
+    shapes = _per_shapes(sigs)
+    wire_dts = [_coll._wire_dtype_of(t, compression) for t in tensors]
+    key = ("allreduce", pset.dispatch_key(), axis, int(op),
+           float(prescale_factor), float(postscale_factor),
+           hierarchical.hierarchical_enabled_for(pset), comp_key)
+    requests: list[dict] = []
+    if grouped:
+        base = name or _coll._auto_name("q_grouped_allreduce", pset)
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    elif name is not None:
+        names = [name]
+    else:
+        names = [_coll._auto_name("q_allreduce", pset)]
+    if svc is not None:
+        from ..dynamic import REQ_ALLREDUCE
+        lowered_op, post = handle_average(op, pset.size(), postscale_factor)
+        gid = -1
+        if grouped:
+            import zlib
+            gid = zlib.crc32(names[0].rsplit(".", 1)[0].encode()) & 0x7FFFFFFF
+        requests = _negotiation_requests(
+            REQ_ALLREDUCE, names, shapes, wire_dts,
+            group_id=gid, reduce_op=int(lowered_op),
+            prescale=float(prescale_factor), postscale=float(post))
+    spec = _QueueSpec("allreduce", pset, axis, op=op,
+                      pre=float(prescale_factor),
+                      post=float(postscale_factor),
+                      compression=compression, svc=svc)
+    entry = _Entry(list(tensors), grouped,
+                   _entry_nbytes(shapes, wire_dts), names, requests,
+                   label=names[0])
+    scheduler().enqueue(key, spec, entry)
+    return _coll._QueuedHandle(entry)
+
+
+def queue_broadcast(tensor, root_rank: int, *, process_set=None, name=None,
+                    axis_name=None):
+    from ..process_sets import _resolve
+    from ..utils import compat as _compat
+    from . import collectives as _coll
+
+    if not enabled() or not _compat.trace_state_clean():
+        return None
+    sigs = _plan_sigs([tensor])
+    if sigs is None:
+        return None
+    pset = _resolve(process_set)
+    if root_rank not in pset.ranks:
+        raise ValueError(
+            f"root_rank {root_rank} not in process set {pset.ranks}")
+    axis = _coll._resolve_axis(axis_name)
+    from .. import engine_service
+    svc = engine_service.get_service(pset)
+    key = ("broadcast", pset.dispatch_key(), axis, int(root_rank))
+    names = [name or _coll._auto_name("q_broadcast", pset)]
+    shapes = _per_shapes(sigs)
+    wire_dts = [jnp.dtype(sigs[0][2])]
+    requests: list[dict] = []
+    if svc is not None:
+        from ..dynamic import REQ_BROADCAST
+        requests = _negotiation_requests(
+            REQ_BROADCAST, names, shapes, wire_dts,
+            root_rank=int(root_rank))
+    spec = _QueueSpec("broadcast", pset, axis, root_rank=int(root_rank),
+                      svc=svc)
+    entry = _Entry([tensor], False, _entry_nbytes(shapes, wire_dts), names,
+                   requests, label=names[0])
+    scheduler().enqueue(key, spec, entry)
+    return _coll._QueuedHandle(entry)
+
+
+def queue_allgather(tensor, *, process_set=None, name=None, axis_name=None):
+    from ..process_sets import _resolve
+    from ..utils import compat as _compat
+    from . import collectives as _coll
+
+    if not enabled() or not _compat.trace_state_clean():
+        return None
+    sigs = _plan_sigs([tensor])
+    if sigs is None:
+        return None
+    pset = _resolve(process_set)
+    axis = _coll._resolve_axis(axis_name)
+    from .. import engine_service
+    svc = engine_service.get_service(pset)
+    key = ("allgather", pset.dispatch_key(), axis)
+    # Negotiation happens inside allgather() at flush time (its program
+    # shape depends on the negotiated recv_splits), but in multi-process
+    # jobs the NAME is drawn from the shared allgather counter NOW, at the
+    # submission point — drawing it at flush time would interleave
+    # nondeterministically with sync allgather calls and desynchronize
+    # names across processes. Single-controller jobs keep name=None so
+    # repeated flushes share one dispatch plan.
+    auto = _coll._auto_name("allgather", pset) if svc is not None else None
+    names = [name if name is not None else auto]
+    spec = _QueueSpec("allgather", pset, axis, svc=svc)
+    entry = _Entry([tensor], False,
+                   _entry_nbytes(_per_shapes(sigs),
+                                 [jnp.dtype(sigs[0][2])]),
+                   names, label=names[0] or "allgather")
+    scheduler().enqueue(key, spec, entry)
+    return _coll._QueuedHandle(entry)
+
+
+def queue_opaque(kind: str, run, *, process_set=None, nbytes: int = 0,
+                 label: str = "", extra_key=()):
+    """Deferred-execution entry with its own executor (sparse async): no
+    cross-entry fusion, but submissions still ride the cycle so a burst
+    of sparse ops drains in one flush."""
+    from ..process_sets import _resolve
+    from ..utils import compat as _compat
+    from . import collectives as _coll
+
+    if not enabled() or not _compat.trace_state_clean():
+        return None
+    pset = _resolve(process_set)
+    from .. import engine_service
+    key = (kind, pset.dispatch_key()) + tuple(extra_key)
+    # svc pins the timer restriction: opaque executors negotiate inside
+    # their run() (e.g. sparse -> allgather), so multi-process entries
+    # must flush from user-thread triggers only, like every other kind.
+    spec = _QueueSpec("sparse", pset, None,
+                      svc=engine_service.get_service(pset))
+    entry = _Entry([None], False, int(nbytes),
+                   [label or _coll._auto_name("q_" + kind, pset)], run=run,
+                   label=label)
+    scheduler().enqueue(key, spec, entry)
+    return _coll._QueuedHandle(entry)
+
+
+# -- module-level conveniences (mirror dispatch_cache's surface) ------------
+
+def flush_all(trigger: str = "barrier") -> None:
+    sched = _scheduler
+    if sched is not None:
+        sched.flush_all(trigger)
+
+
+def drain() -> None:
+    """Clean-shutdown hook (``hvd.shutdown()``): execute everything still
+    queued so no submitted collective is silently dropped."""
+    sched = _scheduler
+    if sched is not None:
+        sched.drain()
+        sched.stop()
+
+
+def abort(reason: str) -> int:
+    """Service-reset hook (elastic teardown): fail pending entries."""
+    sched = _scheduler
+    if sched is not None:
+        return sched.abort(reason)
+    return 0
+
+
+def stats() -> dict:
+    """Scheduler counters (the ``hvd.fusion_stats()`` API)."""
+    return scheduler().stats()
+
+
+def reset() -> None:
+    """Tests / teardown: drop queues (aborting pending entries), stop the
+    timer, and zero the counters."""
+    global _scheduler
+    with _scheduler_lock:
+        sched = _scheduler
+        _scheduler = None
+    if sched is not None:
+        sched.abort("fusion scheduler reset")
+        sched.stop()

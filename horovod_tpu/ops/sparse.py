@@ -146,9 +146,38 @@ def sparse_allreduce_to_dense(grad, max_rows: int, *,
     return rows_to_dense(reduced).astype(grad.dtype)
 
 
-def sparse_allreduce_async(rows, **kw):
+def sparse_allreduce_async(rows, *, op: ReduceOp = ReduceOp.AVERAGE,
+                           process_set=None, name: str | None = None,
+                           axis_name=None):
     """Completion handle over :func:`sparse_allreduce` (reference
     ``sparse_allreduce_async``, ``torch/mpi_ops.py:556-579`` — allgather
-    of indices+values wrapped in a synthesized handle)."""
-    from .collectives import Handle
-    return Handle(sparse_allreduce(rows, **kw))
+    of indices+values wrapped in a synthesized handle). Rides the fusion
+    cycle: the submission is queued and dispatches at the next flush
+    (deferred execution; sparse entries keep their own composition —
+    values+indices allgathers — rather than fusing across entries).
+    The negotiation name is fixed at submission time so multi-process
+    flush timing cannot desynchronize the auto-name counters."""
+    from . import collectives, fusion_cycle
+    from ..process_sets import _resolve
+    pset = _resolve(process_set)
+    fixed_name = name
+    if fixed_name is None and not collectives._axis_is_bound(
+            collectives._resolve_axis(axis_name)):
+        from .. import engine_service
+        if engine_service.get_service(pset) is not None:
+            fixed_name = collectives._auto_name("sparse_allreduce", pset)
+    nbytes = 0
+    values = getattr(rows, "values", None)
+    if values is not None and hasattr(values, "nbytes"):
+        nbytes = int(values.nbytes)
+
+    def run():
+        return sparse_allreduce(rows, op=op, process_set=pset,
+                                name=fixed_name, axis_name=axis_name)
+
+    h = fusion_cycle.queue_opaque(
+        "sparse_allreduce", run, process_set=pset, nbytes=nbytes,
+        label=fixed_name or "sparse_allreduce", extra_key=(int(op),))
+    if h is not None:
+        return h
+    return collectives.Handle(run())

@@ -104,17 +104,16 @@ def _allreduce_tree(tree, *, op, process_set, compression, prescale_factor,
             dense_idx.append(i)
             dense_leaves.append(leaf)
     if dense_leaves:
-        compressed, ctxs = [], []
-        for leaf in dense_leaves:
-            c, ctx = compression.compress(leaf)
-            compressed.append(c)
-            ctxs.append(ctx)
+        # Wire compression is routed INTO the grouped dispatch: the fusion
+        # buffers are keyed by wire dtype (mixed-source-dtype grads share
+        # one compressed buffer) and results are decompressed after the
+        # split — no per-leaf compress/decompress op storm around the call.
         reduced = collectives.grouped_allreduce(
-            compressed, op=op, process_set=process_set,
+            dense_leaves, op=op, process_set=process_set,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-            axis_name=axis_name)
-        for i, r, ctx in zip(dense_idx, reduced, ctxs):
-            out[i] = compression.decompress(r, ctx)
+            axis_name=axis_name, compression=compression)
+        for i, r in zip(dense_idx, reduced):
+            out[i] = r
     return jax.tree.unflatten(treedef, out)
 
 

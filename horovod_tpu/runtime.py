@@ -362,6 +362,15 @@ def shutdown() -> None:
     from . import autotune as _autotune
     from . import engine_service as _engine_service
     from .ops import dispatch_cache as _dispatch_cache
+    from .ops import fusion_cycle as _fusion_cycle
+    # Queued async collectives land BEFORE teardown (every submitted op
+    # eventually executes — the reference drains its tensor queue in
+    # ShutDownHorovod the same way); the cycle timer stops with the world.
+    if _state is not None:
+        try:
+            _fusion_cycle.drain()
+        except Exception:
+            hvd_logging.exception("fusion-cycle drain failed at shutdown")
     _engine_service.reset_service()
     _autotune.reset()
     # Plans hold compiled programs over this world's meshes; none survive

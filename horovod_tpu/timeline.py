@@ -29,6 +29,8 @@ _active = False
 _atexit_registered = False
 
 NEGOTIATE = "NEGOTIATE"
+QUEUE_ENQUEUE = "QUEUE_ENQUEUE"
+CYCLE_FLUSH = "CYCLE_FLUSH"
 PHASE_BEGIN = 0
 PHASE_END = 1
 PHASE_INSTANT = 2
@@ -110,6 +112,23 @@ def record_dispatch(tensor: str, hit: bool) -> None:
     counters live in ``hvd.dispatch_cache_stats()``."""
     if _active:
         record(tensor, "PLAN_HIT" if hit else "PLAN_MISS", PHASE_INSTANT)
+
+
+def record_queue_enqueue(tensor: str) -> None:
+    """Instant ``QUEUE_ENQUEUE`` marker on the tensor's lane when an
+    async submission lands in a fusion-cycle pending queue (the analog of
+    the reference timeline's QUEUE state, ``timeline.cc`` negotiation
+    phases) — the gap to the next CYCLE_FLUSH shows queueing latency."""
+    if _active:
+        record(tensor, QUEUE_ENQUEUE, PHASE_INSTANT)
+
+
+def record_cycle_flush(trigger: str) -> None:
+    """Instant ``CYCLE_FLUSH`` marker on the ``fusion_cycle`` lane, one
+    per flush, labeled with the trigger (threshold/cycle/synchronize/...)
+    so coalescing behavior is visible next to the op ranges."""
+    if _active:
+        record("fusion_cycle", f"{CYCLE_FLUSH}.{trigger}", PHASE_INSTANT)
 
 
 def record(tensor: str, activity: str, phase: int) -> None:

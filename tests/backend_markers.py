@@ -1,0 +1,39 @@
+"""Shared backend-capability skip markers for the spawn-based suites.
+
+The multi-process integration tests launch real ``hvdrun -np 2`` jobs
+whose workers execute cross-process XLA collectives. jax 0.4.x's CPU
+backend does not implement those ("Multiprocess computations aren't
+implemented on the CPU backend", raised from the compiled program), so on
+the virtual-CPU CI mesh these tests are known-red for environmental
+reasons, not product bugs. Marking them skipped gives tier-1 a clean
+signal; on a TPU backend (or a jax >= 0.5 CPU backend, which added
+cross-process CPU computations) they run for real.
+
+Tests that only exercise the negotiation layer — metadata mismatch
+errors, stall warnings, knob gating — stay unmarked: they fail before any
+cross-process program executes and pass on every backend.
+"""
+
+import os
+
+import jax
+import pytest
+
+
+def _cpu_backend_lacks_multiprocess() -> bool:
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or str(getattr(jax.config, "jax_platforms", "") or ""))
+    if "cpu" not in platforms.lower():
+        return False
+    try:
+        major, minor = (int(x) for x in jax.__version__.split(".")[:2])
+    except ValueError:  # pragma: no cover - unparseable dev version
+        return False
+    return (major, minor) < (0, 5)
+
+
+skip_if_cpu_backend = pytest.mark.skipif(
+    _cpu_backend_lacks_multiprocess(),
+    reason="jax < 0.5 CPU backend: \"Multiprocess computations aren't "
+           "implemented on the CPU backend\" — cross-process collective "
+           "execution needs a real accelerator (or jax >= 0.5) here")

@@ -12,6 +12,10 @@ import os
 import subprocess
 import sys
 import textwrap
+from backend_markers import skip_if_cpu_backend
+
+pytestmark = skip_if_cpu_backend
+
 
 WORKER = textwrap.dedent("""\
     import json

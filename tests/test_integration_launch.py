@@ -6,6 +6,10 @@ horovodrun on localhost)."""
 import subprocess
 import sys
 import textwrap
+from backend_markers import skip_if_cpu_backend
+
+pytestmark = skip_if_cpu_backend
+
 
 WORKER = textwrap.dedent("""\
     import os
