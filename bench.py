@@ -173,6 +173,42 @@ def wait_for_backend(max_wait_s: float) -> bool:
         time.sleep(min(120.0, deadline - time.monotonic() - 30.0))
 
 
+def _microbench_mesh():
+    """Shared setup for the host-side microbenches (--dispatch-bench /
+    --cycle-bench / --pipeline-bench): virtual 8-chip CPU mesh, no
+    accelerator probe, ``hvd`` initialized. Factored out of the per-bench
+    copies (ISSUE 3 satellite)."""
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import horovod_tpu as hvd
+    hvd.init()
+    return hvd, hvd.size()
+
+
+def _median_ms(one_round, iters: int, divisor: int = 1) -> float:
+    """Median wall time (ms) per unit over 5 chunks of back-to-back
+    rounds (each chunk, like a training loop's steady state, is timed
+    around a burst of rounds); two untimed rounds warm compile/plan
+    caches first. ``divisor`` converts a round into per-call/per-tensor
+    units."""
+    jax.block_until_ready(one_round())
+    jax.block_until_ready(one_round())
+    chunks = 5
+    per = max(1, iters // chunks)
+    times = []
+    for _ in range(chunks):
+        t0 = time.perf_counter()
+        for _ in range(per):
+            outs = one_round()
+        jax.block_until_ready(outs)
+        times.append((time.perf_counter() - t0) / (per * divisor))
+    return float(np.median(times) * 1e3)
+
+
 def run_dispatch_bench(args) -> None:
     """Per-call eager dispatch overhead microbench (CPU backend, virtual
     8-chip mesh): repeated same-signature ``grouped_allreduce`` with the
@@ -183,19 +219,11 @@ def run_dispatch_bench(args) -> None:
     latency the plan cache (ops/dispatch_cache.py, the ResponseCache HIT
     twin) removes. Prints ONE JSON line; ``value`` is the percent reduction
     in per-call wall time."""
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8")
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
     import jax.numpy as jnp  # noqa: F811 - local for clarity
 
-    import horovod_tpu as hvd
     from horovod_tpu.ops import dispatch_cache
 
-    hvd.init()
-    n = hvd.size()
+    hvd, n = _microbench_mesh()
     size = args.dispatch_size
     tensors = [
         hvd.per_rank([jnp.full((size,), float((r + 1) * (i + 1)), jnp.float32)
@@ -206,32 +234,15 @@ def run_dispatch_bench(args) -> None:
     def one_call():
         return hvd.grouped_allreduce(tensors, op=hvd.Sum)
 
-    def measure(iters: int) -> float:
-        """Median per-call wall time (ms) over 5 chunks of back-to-back
-        calls (each chunk synced once, like the training-loop steady
-        state)."""
-        jax.block_until_ready(one_call())  # compile/plan warmup
-        jax.block_until_ready(one_call())
-        chunks = 5
-        per = max(1, iters // chunks)
-        times = []
-        for _ in range(chunks):
-            t0 = time.perf_counter()
-            for _ in range(per):
-                outs = one_call()
-            jax.block_until_ready(outs)
-            times.append((time.perf_counter() - t0) / per)
-        return float(np.median(times) * 1e3)
-
     prev = os.environ.get("HVD_CACHE_CAPACITY")
     try:
         os.environ["HVD_CACHE_CAPACITY"] = "0"
         ref_out = [np.asarray(o) for o in one_call()]
-        off_ms = measure(args.dispatch_iters)
+        off_ms = _median_ms(one_call, args.dispatch_iters)
         os.environ["HVD_CACHE_CAPACITY"] = "1024"
         dispatch_cache.reset()
         on_out = [np.asarray(o) for o in one_call()]
-        on_ms = measure(args.dispatch_iters)
+        on_ms = _median_ms(one_call, args.dispatch_iters)
         stats = dispatch_cache.stats()
     finally:
         if prev is None:
@@ -268,19 +279,11 @@ def run_cycle_bench(args) -> None:
     independently-submitted small tensors, operations.cc:385-806) applied
     to the eager per-parameter gradient loop. Prints ONE JSON line;
     ``value`` is the percent reduction in per-tensor wall time."""
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8")
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
     import jax.numpy as jnp  # noqa: F811 - local for clarity
 
-    import horovod_tpu as hvd
     from horovod_tpu.ops import dispatch_cache, fusion_cycle
 
-    hvd.init()
-    n = hvd.size()
+    hvd, n = _microbench_mesh()
     count = args.cycle_tensors
     elems = args.cycle_size // 4  # float32 -> 4 bytes/elem
     tensors = [
@@ -293,22 +296,6 @@ def run_cycle_bench(args) -> None:
         handles = [hvd.allreduce_async(t, op=hvd.Sum) for t in tensors]
         return [h.synchronize() for h in handles]
 
-    def measure(iters: int) -> float:
-        """Median per-TENSOR wall time (ms) over 5 chunks of back-to-back
-        submit-all + synchronize-all rounds."""
-        one_round()  # compile/plan warmup
-        one_round()
-        chunks = 5
-        per = max(1, iters // chunks)
-        times = []
-        for _ in range(chunks):
-            t0 = time.perf_counter()
-            for _ in range(per):
-                outs = one_round()
-            jax.block_until_ready(outs)
-            times.append((time.perf_counter() - t0) / (per * count))
-        return float(np.median(times) * 1e3)
-
     prev = {k: os.environ.get(k)
             for k in ("HVD_CYCLE_TIME", "HVD_PENDING_CYCLE_TIME")}
     try:
@@ -316,7 +303,7 @@ def run_cycle_bench(args) -> None:
         # measures the scheduler's win on top of PR 1's dispatch cache).
         os.environ["HVD_CYCLE_TIME"] = "0"
         ref_out = [np.asarray(o) for o in one_round()]
-        off_ms = measure(args.cycle_iters)
+        off_ms = _median_ms(one_round, args.cycle_iters, count)
         # ON: both cycle knobs pinned long so every flush comes from the
         # synchronize (deterministic full-coalesce measurement) — a
         # mid-measurement timer fire on a share-throttled CI box would
@@ -327,7 +314,7 @@ def run_cycle_bench(args) -> None:
         dispatch_cache.reset()
         fusion_cycle.reset()
         on_out = [np.asarray(o) for o in one_round()]
-        on_ms = measure(args.cycle_iters)
+        on_ms = _median_ms(one_round, args.cycle_iters, count)
         stats = hvd.fusion_stats()
     finally:
         for k, v in prev.items():
@@ -356,6 +343,99 @@ def run_cycle_bench(args) -> None:
         "config": {"op": "allreduce_async", "tensors": count,
                    "bytes_per_tensor": args.cycle_size, "dtype": "float32",
                    "iters": args.cycle_iters, "n_chips": n,
+                   "backend": jax.devices()[0].platform},
+    }))
+
+
+def run_pipeline_bench(args) -> None:
+    """Pipelined flush executor + chunk pipeline microbench (CPU backend,
+    virtual 8-chip mesh): a stream of LARGE (default 4 MiB) per-tensor
+    ``allreduce_async`` submissions that the scheduler coalesces into one
+    flush per round — the cycle scheduler's steady state for a training
+    step's gradients. OFF = ``HVD_MAX_INFLIGHT_FLUSHES=1`` (the
+    synchronous executor: the flush runs inline on the triggering thread
+    and its whole multi-MiB fused buffer is ONE monolithic wire program —
+    the PR-2 behavior). ON = 2 in-flight slots +
+    ``HVD_PIPELINE_THRESHOLD``/``HVD_PIPELINE_CHUNKS`` chunking: the
+    fused buffer dispatches as back-to-back chunk programs whose
+    collectives pipeline across the per-device execution queues while the
+    executor overlaps the next flush's fuse with in-flight collectives.
+    Fuse and split stages are identical work in both modes; the measured
+    delta is the wire-stage granularity (plus executor overhead, charged
+    against the pipelined side). Prints ONE JSON line; ``value`` is the
+    percent reduction in per-round wall time."""
+    import jax.numpy as jnp  # noqa: F811 - local for clarity
+
+    from horovod_tpu.ops import dispatch_cache, fusion_cycle
+
+    hvd, n = _microbench_mesh()
+    count = args.pipeline_tensors
+    elems = args.pipeline_size // 4  # float32 -> 4 bytes/elem
+    tensors = [
+        hvd.per_rank([jnp.full((elems,), float(r + 1) * 0.5 ** i,
+                               jnp.float32) for r in range(n)])
+        for i in range(count)
+    ]
+
+    def one_round():
+        handles = [hvd.allreduce_async(t, op=hvd.Sum) for t in tensors]
+        return [h.synchronize() for h in handles]
+
+    knobs = ("HVD_CYCLE_TIME", "HVD_PENDING_CYCLE_TIME",
+             "HVD_FUSION_THRESHOLD", "HVD_MAX_INFLIGHT_FLUSHES",
+             "HVD_PIPELINE_THRESHOLD", "HVD_PIPELINE_CHUNKS")
+    prev = {k: os.environ.get(k) for k in knobs}
+    try:
+        # both modes: timer quiet and fusion threshold unreachable, so
+        # every round's submissions coalesce into ONE synchronize-
+        # triggered flush with an identical composition — only the
+        # executor and the wire-program granularity differ.
+        os.environ["HVD_CYCLE_TIME"] = "500"
+        os.environ["HVD_PENDING_CYCLE_TIME"] = "500"
+        os.environ["HVD_FUSION_THRESHOLD"] = str(1 << 30)
+        os.environ["HVD_MAX_INFLIGHT_FLUSHES"] = "1"
+        dispatch_cache.reset()
+        fusion_cycle.reset()
+        ref_out = [np.asarray(o) for o in one_round()]
+        off_ms = _median_ms(one_round, args.pipeline_iters)
+        os.environ["HVD_MAX_INFLIGHT_FLUSHES"] = "2"
+        os.environ["HVD_PIPELINE_THRESHOLD"] = str(args.pipeline_size)
+        os.environ["HVD_PIPELINE_CHUNKS"] = str(args.pipeline_chunks)
+        dispatch_cache.reset()
+        fusion_cycle.reset()
+        on_out = [np.asarray(o) for o in one_round()]
+        on_ms = _median_ms(one_round, args.pipeline_iters)
+        stats = hvd.fusion_stats()
+        cache_stats = dispatch_cache.stats()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    numerics_match = all(np.allclose(a, b) for a, b in zip(ref_out, on_out))
+    reduction = (off_ms - on_ms) / off_ms * 100.0 if off_ms else 0.0
+    print(json.dumps({
+        "metric": "eager_pipeline_flush_reduction",
+        "value": round(reduction, 1),
+        "unit": "% reduction in wall time per stream of large async "
+                "allreduces",
+        "synchronous": {"ms_per_round": round(off_ms, 4)},
+        "pipelined": {"ms_per_round": round(on_ms, 4),
+                      "pipeline": stats["pipeline"],
+                      "chunked_plan_builds": cache_stats["chunked_builds"]},
+        "numerics_match": bool(numerics_match),
+        "overlap_ratio": round(stats["pipeline"]["overlap_ratio"], 3),
+        "slot_occupancy": round(stats["pipeline"]["slot_occupancy"], 3),
+        "baseline": "same large-tensor allreduce_async stream with "
+                    "HVD_MAX_INFLIGHT_FLUSHES=1 (synchronous flush "
+                    "executor, monolithic wire programs — the "
+                    "pre-pipeline behavior)",
+        "config": {"op": "allreduce_async", "tensors": count,
+                   "bytes_per_tensor": args.pipeline_size,
+                   "chunks": args.pipeline_chunks, "dtype": "float32",
+                   "iters": args.pipeline_iters, "n_chips": n,
                    "backend": jax.devices()[0].platform},
     }))
 
@@ -411,6 +491,26 @@ def main():
                         help="bytes per tensor in --cycle-bench (default "
                              "4 KiB: the small-gradient regime the fusion "
                              "cycle exists for)")
+    parser.add_argument("--pipeline-bench", action="store_true",
+                        help="run the pipelined flush executor + chunk "
+                             "pipeline microbench (CPU backend, no "
+                             "accelerator probe): large-tensor "
+                             "allreduce_async stream, "
+                             "HVD_MAX_INFLIGHT_FLUSHES=2 + chunking vs "
+                             "the synchronous executor")
+    parser.add_argument("--pipeline-iters", type=int, default=20,
+                        help="timed submit+synchronize rounds per mode in "
+                             "--pipeline-bench")
+    parser.add_argument("--pipeline-tensors", type=int, default=6,
+                        help="async allreduces per round in "
+                             "--pipeline-bench")
+    parser.add_argument("--pipeline-size", type=int, default=4 * 1024 * 1024,
+                        help="bytes per tensor in --pipeline-bench "
+                             "(default 4 MiB: the large-tensor regime "
+                             "chunk pipelining exists for)")
+    parser.add_argument("--pipeline-chunks", type=int, default=4,
+                        help="HVD_PIPELINE_CHUNKS for the pipelined mode "
+                             "of --pipeline-bench")
     parser.add_argument("--max-wait", type=float, default=600.0,
                         help="max seconds to wait for the accelerator "
                              "backend to answer a clean-exit probe before "
@@ -424,6 +524,8 @@ def main():
         return run_dispatch_bench(args)
     if args.cycle_bench:
         return run_cycle_bench(args)
+    if args.pipeline_bench:
+        return run_pipeline_bench(args)
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
         # Claiming the backend ourselves now would either fail identically

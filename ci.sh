@@ -28,12 +28,16 @@ python -c "from horovod_tpu._native import build_native; print(build_native(forc
 # backend") are now SKIPPED via tests/backend_markers.py, so the dot
 # count is a clean signal. Raise this when the environment's pass level
 # rises; override with T1_MIN_PASSED.
-T1_MIN_PASSED="${T1_MIN_PASSED:-415}"
+T1_MIN_PASSED="${T1_MIN_PASSED:-427}"
 
 step "1/6 tier-1 gate (the ROADMAP.md command; floor: $T1_MIN_PASSED passed)"
+# faulthandler_timeout: a hung test (e.g. a flush-executor deadlock) dumps
+# every thread's stack after 300 s instead of silently burning the 870 s
+# budget — the dump lands in the log while the timeout still enforces.
 ( set +e; set -o pipefail; rm -f /tmp/_t1.log; \
   timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    -o faulthandler_timeout=300 \
     2>&1 | tee /tmp/_t1.log; \
   dots=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); \
   echo "DOTS_PASSED=$dots (floor $T1_MIN_PASSED)"; \
@@ -63,6 +67,17 @@ print('cycle bench OK: %.1f%% per-tensor reduction (%.3f -> %.3f ms), '
                           d['scheduler_on']['ms_per_tensor'],
                           d['coalesce_ratio']))"
 
+step "1d/6 pipelined-flush microbench (executor + chunk pipeline must hold their large-tensor win)"
+python bench.py --pipeline-bench --pipeline-iters 12 | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+assert d['numerics_match'] is True, d
+assert d['value'] is not None and d['value'] >= 20.0, \
+    'pipelined flush executor lost its large-tensor win: %r' % d
+print('pipeline bench OK: %.1f%% wall-time reduction (%.1f -> %.1f ms/round)'
+      % (d['value'], d['synchronous']['ms_per_round'],
+         d['pipelined']['ms_per_round']))"
+
 if [[ "${1:-}" == "--fast" ]]; then
   step "fast: examples/mnist.py (hvdrun -np 2) then exit"
   env -u XLA_FLAGS python -m horovod_tpu.runner.launch -np 2 -- \
@@ -72,7 +87,7 @@ if [[ "${1:-}" == "--fast" ]]; then
 fi
 
 step "1b/6 test suite, second pass (flake detection)"
-python -m pytest tests/ -q -x
+python -m pytest tests/ -q -x -o faulthandler_timeout=300
 
 step "2/6 driver artifact: single-chip compile check (entry)"
 python - <<'EOF'

@@ -78,6 +78,7 @@ from .ops import (
     broadcast_async,
     broadcast_object,
     dispatch_cache_stats,
+    fusion_flush,
     fusion_stats,
     grouped_allreduce,
     grouped_allreduce_async,
@@ -152,7 +153,7 @@ __all__ = [
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
     "broadcast_", "broadcast_async", "broadcast_object",
-    "dispatch_cache_stats", "fusion_stats",
+    "dispatch_cache_stats", "fusion_flush", "fusion_stats",
     "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
     "grouped_broadcast_async",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",

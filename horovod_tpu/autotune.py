@@ -33,6 +33,11 @@ TPU rebuild; ``operations.cc:584-594``):
   both re-read it live.
 * ``PENDING_CYCLE_TIME`` — the faster pace both consumers drop to while
   work is in flight.
+* ``MAX_INFLIGHT_FLUSHES`` — pipelined flush executor slots (consumer:
+  ``ops/fusion_cycle.FusionScheduler``; 1 = synchronous executor).
+* ``PIPELINE_CHUNKS`` — chunk count for the large-buffer wire pipeline
+  (consumer: ``ops/collectives._chunk_layout`` via the chunked dispatch
+  plans, which rebuild on the override-epoch bump).
 * ``HIERARCHICAL_ALLREDUCE`` — flat vs two-level ICI/DCN schedule
   (consumer: ``ops/hierarchical.hierarchical_enabled_for``).
 * ``CACHE_CAPACITY`` — dispatch-plan/response cache on/off (the
@@ -112,6 +117,20 @@ def _default_tunables() -> list[Tunable]:
         # Flush pace while work is in flight (fusion cycle) / in-flight
         # negotiation tick floor (engine service).
         Tunable(envs.PENDING_CYCLE_TIME, [0.5, 1.0, 2.0, 5.0]),
+        # Pipelined flush executor slots (ops/fusion_cycle.py): first
+        # candidate = the default so enabling autotune changes nothing at
+        # sample 0; 1 = synchronous executor. Safe to tune per-process
+        # tier because slot count never changes flush composition or
+        # program issue order (single FIFO dispatch thread), but decisions
+        # still sync through rank 0 like every knob.
+        Tunable(envs.MAX_INFLIGHT_FLUSHES, [envs.DEFAULT_MAX_INFLIGHT_FLUSHES,
+                                            1, 4]),
+        # Chunk count for the large-buffer wire pipeline (single-
+        # controller only — multi-process plans keep the joined-
+        # compatible one-program composition, so tuning it cannot
+        # desynchronize programs). Flipping it bumps the envs override
+        # epoch, which rebuilds the chunked dispatch plans.
+        Tunable(envs.PIPELINE_CHUNKS, [envs.DEFAULT_PIPELINE_CHUNKS, 2, 8]),
         Tunable(envs.HIERARCHICAL_ALLREDUCE, [0, 1]),
         # Dispatch-plan/response cache on/off, the reference's cache_enabled
         # tunable (parameter_manager.cc CacheEnabledParameter). Default-on

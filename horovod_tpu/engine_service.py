@@ -106,6 +106,23 @@ class _Pending:
         self.response: Response | None = None
 
 
+class NegotiationTicket:
+    """An in-flight ``negotiate_many`` round, split from its wait so the
+    fusion scheduler's pipelined flush executor can *submit* flush k+1's
+    negotiation at the (rank-deterministic) trigger point and only *wait*
+    for it when the executor reaches the batch — the KV round trip then
+    overlaps flush k's in-flight collective instead of serializing after
+    it. Exactly one of :meth:`DynamicService.negotiate_many_wait` /
+    :meth:`DynamicService.negotiate_many_cancel` must consume a ticket."""
+
+    __slots__ = ("requests", "pends", "submitted_at")
+
+    def __init__(self, requests, pends):
+        self.requests = requests
+        self.pends = pends
+        self.submitted_at = time.monotonic()
+
+
 class DynamicService:
     """Owns one engine + transport and ticks negotiation cycles on a
     background thread."""
@@ -178,6 +195,14 @@ class DynamicService:
                        timeout: float | None = None) -> list[Response]:
         """Enqueue a batch (e.g. one grouped op) and wait for all plans —
         all requests land in one cycle, so the wait is one round trip."""
+        return self.negotiate_many_wait(self.negotiate_many_submit(requests),
+                                        timeout=timeout)
+
+    def negotiate_many_submit(self, requests: list[dict]) -> NegotiationTicket:
+        """First half of :meth:`negotiate_many`: register and enqueue the
+        batch (waking the cycle loop) without waiting. The negotiation
+        round proceeds on the cycle thread; the returned ticket must be
+        consumed by ``negotiate_many_wait`` or ``negotiate_many_cancel``."""
         if self._failure:
             raise HorovodCollectiveError(self._failure)
         pends = []
@@ -221,9 +246,18 @@ class DynamicService:
         for req in requests:
             _timeline.record(req["name"], _timeline.NEGOTIATE,
                              _timeline.PHASE_BEGIN)
+        return NegotiationTicket(requests, pends)
+
+    def negotiate_many_wait(self, ticket: NegotiationTicket,
+                            timeout: float | None = None) -> list[Response]:
+        """Second half of :meth:`negotiate_many`: block until every plan
+        in the ticket's batch arrives (or times out). The timeout budget
+        starts at *submission*, so an overlapped round whose responses
+        already landed while other flushes executed returns immediately."""
+        requests, pends = ticket.requests, ticket.pends
         deadline = (timeout if timeout is not None
                     else self._exchange_timeout)
-        end = time.monotonic() + deadline
+        end = ticket.submitted_at + deadline
         timed_out = False
         try:
             for req, pend in zip(requests, pends):
@@ -232,6 +266,12 @@ class DynamicService:
                     while not pend.event.wait(60.0):
                         if self._failure:
                             break
+                    continue
+                if pend.event.is_set():
+                    # overlapped round already served while other flushes
+                    # executed — never a timeout, however late the wait
+                    # starts (the pipelined executor may reach this batch
+                    # long after submission)
                     continue
                 if remaining <= 0 or not pend.event.wait(remaining):
                     timed_out = True
@@ -262,6 +302,24 @@ class DynamicService:
                 raise HorovodCollectiveError(resp.error_message)
             out.append(resp)
         return out
+
+    def negotiate_many_cancel(self, ticket: NegotiationTicket) -> None:
+        """Release a submitted-but-never-waited ticket (the flush executor
+        aborting mid-pipeline): drop the pending registrations and abandon
+        undelivered names in the native engine so they can be reused —
+        a leaked ticket would otherwise pin its names in ``_pending``
+        forever and raise DuplicateNameError on any retry."""
+        for req in ticket.requests:
+            _timeline.record(req["name"], _timeline.NEGOTIATE,
+                             _timeline.PHASE_END)
+        with self._mu:
+            for req, pend in zip(ticket.requests, ticket.pends):
+                self._pending.pop(req["name"], None)
+                if pend.response is None:
+                    try:
+                        self.engine.abandon(req["name"])
+                    except Exception:
+                        pass  # engine may already be torn down
 
     def stop(self):
         self._shutdown.set()

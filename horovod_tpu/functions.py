@@ -44,13 +44,15 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0,
     """Broadcast optimizer state (reference ``broadcast_optimizer_state``).
     optax states are array pytrees, so this is the same fused tree
     broadcast — non-array leaves (step counts as python ints, None) pass
-    through."""
+    through. Array leaves ride the fusion-cycle broadcast queue like
+    :func:`broadcast_parameters`, so a params + optimizer-state restore
+    coalesces into one pipelined flush instead of two dispatch storms."""
     leaves, treedef = jax.tree.flatten(opt_state)
     is_array = [hasattr(x, "dtype") and hasattr(x, "shape") for x in leaves]
-    synced = collectives.grouped_broadcast(
+    handle = collectives.grouped_broadcast_async(
         [x for x, a in zip(leaves, is_array) if a], root_rank,
         process_set=process_set)
-    it = iter(synced)
+    it = iter(handle.synchronize())
     out = [next(it) if a else x for x, a in zip(leaves, is_array)]
     return jax.tree.unflatten(treedef, out)
 

@@ -26,6 +26,7 @@ from .collectives import (
 )
 from .dispatch_cache import reset as reset_dispatch_cache
 from .dispatch_cache import stats as dispatch_cache_stats
+from .fusion_cycle import fusion_flush
 from .fusion_cycle import reset as reset_fusion_cycle
 from .fusion_cycle import stats as fusion_stats
 from .adasum import adasum_allreduce
@@ -52,7 +53,7 @@ __all__ = [
     "grouped_broadcast", "grouped_broadcast_async", "join", "per_rank",
     "poll", "reducescatter", "synchronize", "adasum_allreduce",
     "dispatch_cache_stats", "reset_dispatch_cache",
-    "fusion_stats", "reset_fusion_cycle",
+    "fusion_flush", "fusion_stats", "reset_fusion_cycle",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",
     "sparse_allreduce_to_dense",

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import pickle
+import threading
 from typing import Sequence
 
 import jax
@@ -53,6 +54,7 @@ from ..dynamic import (
 from ..process_sets import ProcessSet, _resolve
 from . import dispatch_cache as _dispatch
 from . import hierarchical
+from .program_issue import issue_serialized as _issue_serialized
 from .reduce_ops import ReduceOp, handle_average
 from ..utils import compat as _compat
 from ..utils import envs
@@ -408,9 +410,9 @@ def _eager_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
         return out[0] if (bundled and row0) else out
     in_spec = P(axis) if bundled else P()
     out_spec = P() if (row0 or not bundled) else P(axis)
-    return jax.jit(jax.shard_map(
+    return _issue_serialized(jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-        check_vma=False))
+        check_vma=False)))
 
 
 def _grouped_allreduce_smap(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
@@ -435,9 +437,9 @@ def _eager_grouped_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
     dispatcher-owned temporaries (never user arrays) — those buffers are
     donated so the reduction reuses their HBM instead of holding input and
     output live simultaneously."""
-    return jax.jit(
+    return _issue_serialized(jax.jit(
         _grouped_allreduce_smap(mesh, axis, op, pre, post, num_bufs, bundled),
-        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -450,8 +452,8 @@ def _eager_allgather_fn(mesh: Mesh, axis: str, bundled: bool = True):
         def inner(x):  # replicated (d0, ...) -> (n*d0, ...)
             return lax.all_gather(x, axis, tiled=True)
         in_spec = P()
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=in_spec, out_specs=P(), check_vma=False))
+    return _issue_serialized(jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=in_spec, out_specs=P(), check_vma=False)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -460,9 +462,9 @@ def _eager_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
     def inner(x):  # -> (...) replicated
         return _broadcast_traced(x[0] if bundled else x, axis, root_pos,
                                  None, None)
-    return jax.jit(jax.shard_map(
+    return _issue_serialized(jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=P(axis) if bundled else P(),
-        out_specs=P(), check_vma=False))
+        out_specs=P(), check_vma=False)))
 
 
 def _grouped_broadcast_smap(mesh: Mesh, axis: str, root_pos: int,
@@ -482,9 +484,9 @@ def _grouped_broadcast_smap(mesh: Mesh, axis: str, root_pos: int,
 def _eager_grouped_broadcast_fn(mesh: Mesh, axis: str, root_pos: int,
                                 num_bufs: int, bundled: bool = True,
                                 donate: tuple = ()):
-    return jax.jit(
+    return _issue_serialized(jax.jit(
         _grouped_broadcast_smap(mesh, axis, root_pos, num_bufs, bundled),
-        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d)))
 
 
 def _wire_dtype_of(t, compression):
@@ -588,8 +590,8 @@ def _split_fused(fused_outputs, metas, count: int) -> list:
 def _eager_alltoall_fn(mesh: Mesh, axis: str):
     def inner(x):  # (1, s, ...) -> (s, ...) per-rank
         return _alltoall_traced(x[0], axis, None)
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+    return _issue_serialized(jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -609,17 +611,17 @@ def _eager_uneven_alltoall_fn(mesh: Mesh, axis: str):
         return lax.all_to_all(sel, axis, split_axis=0, concat_axis=0,
                               tiled=True)
 
-    return jax.jit(jax.shard_map(
+    return _issue_serialized(jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=P(axis), check_vma=False))
+        out_specs=P(axis), check_vma=False)))
 
 
 @functools.lru_cache(maxsize=None)
 def _eager_reducescatter_fn(mesh: Mesh, axis: str, op: ReduceOp, post: float):
     def inner(x):  # (1, d0, ...) -> (d0/n, ...) per-rank
         return _reducescatter_traced(x[0], axis, op, post, None)
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+    return _issue_serialized(jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False)))
 
 
 def _as_bundle(tensor, pset: ProcessSet, allow_ragged: bool = False):
@@ -915,6 +917,25 @@ def _build_allreduce_plan(sig, pset: ProcessSet, axis, op: ReduceOp,
     bundled = sig[0] == "b"
     per_shape = sig[1][1:] if bundled else sig[1]
     dtype = jnp.dtype(sig[2])
+    negotiate = _plan_negotiation(
+        "allreduce", REQ_ALLREDUCE, name, per_shape, dtype, pset,
+        reduce_op=int(lowered_op), prescale=pre, postscale=post)
+    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
+    if negotiate is not None:
+        # Multi-process job: compose EXACTLY like the joined-rank zero
+        # reconstruction (``_execute_joined_zeros``: wire-dtype (n, ...)
+        # bundle through ``_execute_allreduce_bundle``) so active and
+        # joined processes lower identical multiprocess computations —
+        # the ROADMAP open item on plan-path/join alignment. The row-0
+        # program variant and the chunk pipeline stay single-controller
+        # optimizations: a joined rank cannot reconstruct them from
+        # response metadata.
+        def execute(t):
+            bundle, _ = _as_bundle(t, pset)
+            return _execute_allreduce_bundle(bundle, pset, axis,
+                                             lowered_op, pre, post)
+        return _dispatch.DispatchPlan(name or "allreduce", "ALLREDUCE",
+                                      nbytes, negotiate, execute)
     if (lowered_op == ReduceOp.SUM
             and hierarchical.hierarchical_enabled_for(pset)):
         fn = hierarchical._eager_hier_allreduce_fn(
@@ -929,10 +950,6 @@ def _build_allreduce_plan(sig, pset: ProcessSet, axis, op: ReduceOp,
     else:
         def execute(t):
             return fn(jnp.asarray(t))
-    negotiate = _plan_negotiation(
-        "allreduce", REQ_ALLREDUCE, name, per_shape, dtype, pset,
-        reduce_op=int(lowered_op), prescale=pre, postscale=post)
-    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
     return _dispatch.DispatchPlan(name or "allreduce", "ALLREDUCE", nbytes,
                                   negotiate, execute)
 
@@ -970,10 +987,194 @@ def _plan_fused_programs(metas, smap, n: int, count: int, bundled: bool,
 
         def wire(*fused):
             return tuple(_split_fused(list(smap(*fused)), metas, count))
-    fuse_fn = jax.jit(fuse)
-    wire_fn = jax.jit(
-        wire, donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+    fuse_fn = _issue_serialized(jax.jit(fuse))
+    wire_fn = _issue_serialized(jax.jit(
+        wire, donate_argnums=tuple(i for i, d in enumerate(donate) if d)))
     return fuse_fn, wire_fn
+
+
+# ---------------------------------------------------------------------------
+# chunked wire pipeline (large fused buffers; see docs/pipeline.md)
+# ---------------------------------------------------------------------------
+
+def _pipeline_key():
+    """Plan-cache key component for the chunk pipeline: the knobs that
+    change a chunked plan's program composition — including the ping-pong
+    setting, which swaps the fuse/piece program shapes — or None when
+    chunking is off (so disabling the pipelined executor reuses the
+    pre-pipeline plans byte-for-byte)."""
+    if not envs.pipeline_chunking_enabled():
+        return None
+    return (envs.pipeline_threshold_bytes(), envs.pipeline_chunks(),
+            (envs.get(envs.PIPELINE_PINGPONG, "auto") or "auto")
+            .strip().lower())
+
+
+def _chunk_layout(metas):
+    """Piece layout for the software pipeline: each wire bucket whose
+    payload exceeds ``HVD_PIPELINE_THRESHOLD`` is split into
+    ``HVD_PIPELINE_CHUNKS`` contiguous flat ranges, each dispatched as
+    its own collective program (the collective of chunk i then overlaps
+    the fuse/split — and the neighbors' per-device execution — of chunks
+    i±1, ByteScheduler's tensor-partitioning insight applied to the
+    fusion buffer). Sub-threshold buckets stay one piece. Returns a list
+    of ``(bucket_idx, start_elem, end_elem)`` or None when no bucket
+    chunks (the plan then keeps the one-program wire stage)."""
+    if not envs.pipeline_chunking_enabled():
+        return None
+    threshold = envs.pipeline_threshold_bytes()
+    chunks = envs.pipeline_chunks()
+    layout, any_chunked = [], False
+    for bi, (dt, _bidxs, shapes, _srcs) in enumerate(metas):
+        total = sum(int(np.prod(shp) or 1) for shp in shapes)
+        if total * jnp.dtype(dt).itemsize <= threshold or total < chunks:
+            layout.append((bi, 0, total))
+            continue
+        any_chunked = True
+        step = -(-total // chunks)  # ceil: last chunk may be smaller
+        layout.extend((bi, a, min(a + step, total))
+                      for a in range(0, total, step))
+    return layout if any_chunked else None
+
+
+@functools.lru_cache(maxsize=None)
+def _piece_allreduce_fn(mesh: Mesh, axis: str, op: ReduceOp, pre: float,
+                        post: float, bundled: bool, donate: bool,
+                        recycle: bool):
+    """One chunk's wire program: single-buffer shard-mapped reduction
+    with the row-0 extract INSIDE the shard_map (``out_specs=P()`` hands
+    back the replicated chunk directly — extracting row 0 outside the
+    shard_map lowers to a cross-device gather that measured ~6x the
+    collective itself on the CPU mesh). ``donate`` recycles the chunk
+    buffer's HBM into the reduction (chunk buffers are fuse-stage
+    outputs, always dispatcher-owned). ``recycle`` additionally returns
+    the donated input as a second output — with real donation the output
+    aliases the input's buffer, handing its memory back to the caller as
+    the next flush's ping-pong scratch."""
+    def inner(x):
+        out = _allreduce_traced(x, axis, op, pre, post, None)
+        return out[0] if bundled else out
+
+    smap = jax.shard_map(inner, mesh=mesh,
+                         in_specs=P(axis) if bundled else P(),
+                         out_specs=P(), check_vma=False)
+
+    def one(x):
+        out = smap(x)
+        return (out, x) if recycle else out
+
+    return _issue_serialized(jax.jit(
+        one, donate_argnums=(0,) if donate else ()))
+
+
+def _plan_chunked_programs(metas, layout, mesh: Mesh, axis, op: ReduceOp,
+                           pre: float, post: float, n: int, count: int,
+                           bundled: bool, pingpong: bool, donate: bool):
+    """Program set for a chunk-pipelined grouped allreduce plan.
+
+    Stage 1 (``fuse``) packs user tensors into the per-dtype wire buffers
+    AND slices them into the pipeline pieces, all in one program. Stage 2
+    is one collective program per piece, dispatched back-to-back — JAX
+    dispatch is asynchronous, so piece i+1 is enqueued while piece i's
+    collective runs; the per-device queues then pipeline the pieces
+    (measured ~30-40% wall-time reduction for 4 MiB buffers on the CPU
+    mesh vs one monolithic wire program). Stage 3 (``split``) reassembles
+    the piece results and splits them back into per-tensor outputs.
+
+    With ``pingpong`` the fuse program takes a tuple of donated scratch
+    buffers (pure memory donors, never read) and each piece program
+    returns its donated input as a recycled buffer — steady-state flushes
+    then rotate ``HVD_MAX_INFLIGHT_FLUSHES`` buffer sets instead of
+    allocating fresh wire memory per flush."""
+    piece_shapes = []
+    for bi, a, b in layout:
+        dt = metas[bi][0]
+        piece_shapes.append(((n, b - a) if bundled else (b - a,), dt))
+
+    def _bufs(inputs):
+        if bundled:
+            return [jnp.concatenate([inputs[i].astype(dt).reshape(n, -1)
+                                     for i in bidxs], axis=1)
+                    for (dt, bidxs, _s, _src) in metas]
+        return [jnp.concatenate([inputs[i].astype(dt).reshape(-1)
+                                 for i in bidxs])
+                if len(bidxs) > 1
+                else inputs[bidxs[0]].astype(dt).reshape(-1)
+                for (dt, bidxs, _s, _src) in metas]
+
+    def _slices(bufs):
+        if bundled:
+            return tuple(bufs[bi][:, a:b] for (bi, a, b) in layout)
+        return tuple(bufs[bi][a:b] for (bi, a, b) in layout)
+
+    if pingpong:
+        def fuse(scratch, *inputs):
+            del scratch  # memory donors only; outputs reuse their HBM
+            return _slices(_bufs(list(inputs)))
+        fuse_fn = _issue_serialized(jax.jit(fuse, donate_argnums=(0,)))
+    else:
+        def fuse(*inputs):
+            return _slices(_bufs(list(inputs)))
+        fuse_fn = _issue_serialized(jax.jit(fuse))
+
+    piece_fns = [
+        _piece_allreduce_fn(mesh, axis, op, pre, post, bundled,
+                            donate=donate, recycle=pingpong)
+        for _ in layout
+    ]
+
+    def split(*piece_outs):
+        vecs = []
+        for bi in range(len(metas)):
+            parts = [piece_outs[j] for j, (b, _a, _e) in enumerate(layout)
+                     if b == bi]
+            vecs.append(parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts))
+        return tuple(_split_fused(vecs, metas, count))
+
+    split_fn = _issue_serialized(jax.jit(split))
+    return fuse_fn, piece_fns, split_fn, piece_shapes
+
+
+def _chunked_execute(fuse_fn, piece_fns, split_fn, piece_shapes,
+                     canonicalize, pingpong: bool):
+    """Execute closure for a chunked plan. ``canonicalize`` maps the user
+    tensor list to the fuse program's inputs. The scratch pool (ping-pong
+    buffer sets recycled by the piece programs) is per-plan state — i.e.
+    per flush signature — bounded by the executor's slot count so at most
+    one spare set exists per in-flight flush."""
+    pool: list = []
+    pool_lock = threading.Lock()
+
+    def execute(ts):
+        inputs = canonicalize(ts)
+        with _timeline.pipeline_stage("FUSE"):
+            if pingpong:
+                with pool_lock:
+                    scratch = pool.pop() if pool else None
+                if scratch is None:
+                    scratch = tuple(jnp.zeros(shp, dt)
+                                    for shp, dt in piece_shapes)
+                pieces = fuse_fn(scratch, *inputs)
+            else:
+                pieces = fuse_fn(*inputs)
+        outs, recycled = [], []
+        with _timeline.pipeline_stage("DISPATCH"):
+            for piece, fn in zip(pieces, piece_fns):
+                r = fn(piece)
+                if pingpong:
+                    outs.append(r[0])
+                    recycled.append(r[1])
+                else:
+                    outs.append(r)
+        if pingpong:
+            with pool_lock:
+                if len(pool) < max(envs.max_inflight_flushes(), 1):
+                    pool.append(tuple(recycled))
+        with _timeline.pipeline_stage("SPLIT"):
+            return list(split_fn(*outs))
+
+    return execute
 
 
 def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
@@ -991,11 +1192,64 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
     hier = (lowered_op == ReduceOp.SUM
             and hierarchical.hierarchical_enabled_for(pset))
     metas = _fusion_metas(shapes, [s[2] for s in sigs], wire_dts)
+    # Negotiation metadata carries the WIRE dtype — that is what peers
+    # must agree on (and what a joined rank's zero buffers reduce in).
+    negotiate = _plan_group_negotiation(
+        "grouped_allreduce", REQ_ALLREDUCE, name,
+        [(shp, dt) for shp, dt in zip(shapes, wire_dts)], pset,
+        reduce_op=int(lowered_op), prescale=pre, postscale=post)
+    nbytes = sum(int(np.prod(shp) or 1) * dt.itemsize
+                 for shp, dt in zip(shapes, wire_dts))
+    if negotiate is not None:
+        # Multi-process job: compose EXACTLY like the joined-rank zero
+        # reconstruction and the queued flush path — canonical wire-dtype
+        # bundles through ``_execute_grouped_bundles`` (eager fuse, one
+        # jit(shard_map) wire program per bucket set, eager split), the
+        # one composition a joined process can rebuild from response
+        # metadata alone. Split fuse/wire jits, donation, and the chunk
+        # pipeline remain single-controller-only (ROADMAP alignment item).
+        def execute(ts):
+            bundles = [_as_bundle(t, pset)[0] for t in ts]
+            wire = [_wire_dtype_of(b, compression) for b in bundles]
+            return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
+                                            pre, post, count,
+                                            wire_dtypes=wire)
+        return _dispatch.DispatchPlan(name or "grouped_allreduce",
+                                      "GROUPED_ALLREDUCE", nbytes,
+                                      negotiate, execute)
     if bundled:
         donate = _grouped_donate_mask(
             metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
     else:
         donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
+    layout = None if hier else _chunk_layout(metas)
+    if layout is not None:
+        # Chunk pipeline: fuse emits per-chunk wire buffers, each chunk's
+        # collective is its own back-to-back-dispatched program, one split
+        # program reassembles (see _plan_chunked_programs). Donation and
+        # ping-pong buffer recycling engage where donation is real
+        # (off-CPU — the CPU backend ignores donation but still charges
+        # per-call bookkeeping for it); forcing HVD_PIPELINE_PINGPONG=1
+        # forces both (the recycle output needs the donate intent).
+        platform = pset.mesh().devices.flat[0].platform
+        pingpong = (all(donate)
+                    and envs.pipeline_pingpong_enabled(platform))
+        piece_donate = envs.donation_effective(platform) or pingpong
+        fuse_fn, piece_fns, split_fn, piece_shapes = _plan_chunked_programs(
+            metas, layout, pset.mesh(), axis, lowered_op, pre, post, n,
+            count, bundled, pingpong, piece_donate)
+        if bundled:
+            def canonicalize(ts):
+                return [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
+        else:
+            def canonicalize(ts):
+                return [jnp.asarray(t) for t in ts]
+        execute = _chunked_execute(fuse_fn, piece_fns, split_fn,
+                                   piece_shapes, canonicalize, pingpong)
+        return _dispatch.DispatchPlan(name or "grouped_allreduce",
+                                      "GROUPED_ALLREDUCE", nbytes,
+                                      negotiate, execute, variant="chunked",
+                                      pieces=len(layout))
     if hier:
         smap = hierarchical._hier_grouped_allreduce_smap(
             hierarchical.hierarchical_mesh(), lowered_op, pre, post,
@@ -1012,14 +1266,6 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
     else:
         def execute(ts):
             return list(wire_fn(*fuse_fn(*[jnp.asarray(t) for t in ts])))
-    # Negotiation metadata carries the WIRE dtype — that is what peers
-    # must agree on (and what a joined rank's zero buffers reduce in).
-    negotiate = _plan_group_negotiation(
-        "grouped_allreduce", REQ_ALLREDUCE, name,
-        [(shp, dt) for shp, dt in zip(shapes, wire_dts)], pset,
-        reduce_op=int(lowered_op), prescale=pre, postscale=post)
-    nbytes = sum(int(np.prod(shp) or 1) * dt.itemsize
-                 for shp, dt in zip(shapes, wire_dts))
     return _dispatch.DispatchPlan(name or "grouped_allreduce",
                                   "GROUPED_ALLREDUCE", nbytes, negotiate,
                                   execute)
@@ -1266,7 +1512,8 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
                    pset.dispatch_key(), int(op), float(prescale_factor),
                    float(postscale_factor),
                    hierarchical.hierarchical_enabled_for(pset),
-                   envs.fusion_threshold_bytes(), comp_key)
+                   envs.fusion_threshold_bytes(), comp_key,
+                   _pipeline_key())
             plan = _dispatch.lookup(key)
             if plan is None:
                 plan = _build_grouped_allreduce_plan(

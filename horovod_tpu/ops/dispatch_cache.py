@@ -51,17 +51,26 @@ class DispatchPlan:
     accounting, timeline labels, and the executor closure wrapping the
     compiled program. ``negotiate`` is ``None`` when the plan pinned the
     no-service decision (single-process job / non-member) — the per-call
-    ``get_service`` + auto-name round is skipped entirely."""
+    ``get_service`` + auto-name round is skipped entirely.
 
-    __slots__ = ("label", "activity", "nbytes", "negotiate", "execute")
+    ``variant`` distinguishes the one-wire-program composition
+    (``"fused"``) from the chunk-pipelined one (``"chunked"``, fused wire
+    buffers past ``HVD_PIPELINE_THRESHOLD`` split into ``pieces``
+    back-to-back collective programs — see docs/pipeline.md)."""
+
+    __slots__ = ("label", "activity", "nbytes", "negotiate", "execute",
+                 "variant", "pieces")
 
     def __init__(self, label: str, activity: str, nbytes: int | None,
-                 negotiate: Callable | None, execute: Callable):
+                 negotiate: Callable | None, execute: Callable,
+                 variant: str = "fused", pieces: int = 1):
         self.label = label
         self.activity = activity
         self.nbytes = nbytes
         self.negotiate = negotiate
         self.execute = execute
+        self.variant = variant
+        self.pieces = pieces
 
     def run(self, arg):
         if self.negotiate is None:
@@ -88,6 +97,7 @@ _misses = 0
 _invalidations = 0
 _evictions = 0
 _negotiation_skips = 0
+_chunked_builds = 0
 
 
 def capacity() -> int:
@@ -139,12 +149,14 @@ def lookup(key: tuple) -> DispatchPlan | None:
 def store(key: tuple, plan: DispatchPlan) -> None:
     """Insert ``plan`` (LRU-evicting past capacity). No-op when caching is
     disabled, so the build-per-call path stays allocation-clean."""
-    global _evictions, _epoch
+    global _evictions, _epoch, _chunked_builds
     cap = capacity()
     if cap <= 0:
         return
     epoch = _current_epoch()
     with _lock:
+        if plan is not UNPLANNABLE and plan.variant == "chunked":
+            _chunked_builds += 1
         if _epoch != epoch:
             _flush_locked(count_invalidation=_epoch is not None)
             _epoch = epoch
@@ -187,14 +199,16 @@ def stats() -> dict:
             "invalidations": _invalidations,
             "evictions": _evictions,
             "negotiation_skips": _negotiation_skips,
+            "chunked_builds": _chunked_builds,
         }
 
 
 def reset_stats() -> None:
     global _hits, _misses, _invalidations, _evictions, _negotiation_skips
+    global _chunked_builds
     with _lock:
         _hits = _misses = _invalidations = _evictions = 0
-        _negotiation_skips = 0
+        _negotiation_skips = _chunked_builds = 0
 
 
 def reset() -> None:

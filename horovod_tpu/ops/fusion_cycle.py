@@ -56,10 +56,35 @@ wall-clock:
   process only changes *when* entries negotiate, never *what* program
   runs.
 
+**Pipelined flush executor** (``HVD_MAX_INFLIGHT_FLUSHES``, default 2):
+flush triggers only *drain* a queue and hand the entry batch to a
+dedicated dispatch thread with a bounded in-flight window, so flush k+1's
+host-side fuse (and, in multi-process jobs, its ``negotiate_many`` round,
+submitted at the trigger point via the split
+:meth:`~horovod_tpu.engine_service.DynamicService.negotiate_many_submit`)
+overlaps flush k's in-flight device collective instead of serializing
+against the triggering thread's enqueues. The executor is deliberately a
+SINGLE thread consuming a FIFO queue: slot admission order derives from
+submission order only (never completion timing), which preserves
+per-signature FIFO result order, the PR-2 rank-deterministic composition
+contract, and — critically — a serial collective program issue order
+(two threads interleaving the per-device enqueues of two collectives
+deadlock the backend rendezvous; see ``ops/program_issue.py``). The
+slots bound how many dispatched flushes may be device-incomplete at
+once: admitting a batch past the window first blocks on the oldest
+in-flight flush (GIL released — producers keep enqueueing).
+``HVD_MAX_INFLIGHT_FLUSHES=0/1`` restores the synchronous
+execute-on-the-triggering-thread behavior byte-for-byte. Fused wire
+buffers past ``HVD_PIPELINE_THRESHOLD`` additionally dispatch as
+``HVD_PIPELINE_CHUNKS`` chunk programs (``collectives._chunk_layout``,
+docs/pipeline.md).
+
 Statistics surface through :func:`stats` (exported as
-``hvd.fusion_stats()``); the timeline gains ``QUEUE_ENQUEUE`` and
-``CYCLE_FLUSH`` instant events. The scheduler's off switch is
-``HVD_CYCLE_TIME=0`` (immediate dispatch, the pre-queue behavior).
+``hvd.fusion_stats()``; the ``pipeline`` block carries slot occupancy and
+overlap ratio); the timeline gains ``QUEUE_ENQUEUE``, ``CYCLE_FLUSH``,
+and ``INFLIGHT_DEPTH`` instant events plus ``PIPELINE_*`` stage spans.
+The scheduler's off switch is ``HVD_CYCLE_TIME=0`` (immediate dispatch,
+the pre-queue behavior).
 """
 
 from __future__ import annotations
@@ -168,6 +193,22 @@ class _Queue:
         self.names: set = set()  # pending negotiation names (O(1) clash check)
 
 
+class _Batch:
+    """One drained flush handed to the pipelined executor: the queue's
+    spec, its entries in submission order, the trigger that drained it,
+    and — for multi-process queues — the negotiation ticket submitted at
+    the (rank-deterministic) trigger point so the KV round overlaps
+    earlier in-flight flushes."""
+
+    __slots__ = ("spec", "entries", "trigger", "ticket")
+
+    def __init__(self, spec, entries, trigger, ticket=None):
+        self.spec = spec
+        self.entries = entries
+        self.trigger = trigger
+        self.ticket = ticket
+
+
 class FusionScheduler:
     """Owns the pending queues, the cycle timer thread, and the flush
     statistics. Normally a process-wide singleton (:func:`scheduler`);
@@ -188,11 +229,24 @@ class FusionScheduler:
             "flushed_tensors": 0,
             "flushed_bytes": 0,
             "dispatches": 0,
+            "wire_programs": 0,
             "flushes": {t: 0 for t in FLUSH_TRIGGERS},
         }
         # (trigger, queue key, entry names) per flush — the composition
         # record the determinism tests compare across schedulers.
         self.flush_history: deque = deque(maxlen=64)
+        # -- pipelined flush executor state (see _exec_loop) --
+        self._exec_cv = threading.Condition(threading.Lock())
+        self._exec_q: "deque[_Batch]" = deque()
+        self._exec_busy = False
+        self._exec_stop = False
+        self._exec_thread: threading.Thread | None = None
+        self._exec_inflight: deque = deque()  # result leaves per batch
+        self._exec_names: set = set()  # svc names submitted, not yet done
+        self._pstats = {
+            "submitted": 0, "executed": 0, "overlapped": 0,
+            "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
+        }
 
     # -- enqueue -----------------------------------------------------------
 
@@ -205,11 +259,22 @@ class FusionScheduler:
             # pending in the same queue would silently orphan the first
             # request and stall the flush. Flush the queue first so the
             # two negotiations stay sequential, like immediate dispatch.
+            # With the pipelined executor the earlier submission's
+            # negotiation may also still be in flight downstream of its
+            # flush — quiesce the pipeline before reusing the name.
             with self._mu:
                 q = self._queues.get(key)
                 clash = q is not None and not q.names.isdisjoint(entry.names)
+            with self._exec_cv:
+                exec_clash = not self._exec_names.isdisjoint(entry.names)
             if clash:
                 self.flush_queue(key, "name-reuse")
+            if clash or exec_clash:
+                # Wait for the clashing names specifically (not just an
+                # executor quiesce): the earlier flush may still be
+                # between its _mu-side name registration and its batch
+                # submission, where the executor queue looks idle.
+                self._wait_names_clear(entry.names)
         with self._mu:
             q = self._queues.get(key)
             if q is None:
@@ -240,7 +305,16 @@ class FusionScheduler:
 
     def flush_queue(self, key: tuple, trigger: str) -> None:
         """Flush one queue (no-op when it is already drained/being
-        flushed by another thread — the entry events carry completion)."""
+        flushed by another thread — the entry events carry completion).
+
+        With the pipelined executor on, this only DRAINS the queue,
+        records the flush composition, and submits the batch — execution
+        happens on the executor thread, so the triggering thread (a
+        producer hitting the threshold, the cycle timer, a synchronize)
+        returns immediately and flush k+1's enqueues overlap flush k's
+        fuse/negotiate/collective. ``HVD_MAX_INFLIGHT_FLUSHES<=1``
+        executes inline, the pre-pipeline behavior."""
+        pipelined = envs.pipeline_enabled()
         with self._mu:
             q = self._queues.pop(key, None)
             if q is None or not q.entries:
@@ -255,22 +329,65 @@ class FusionScheduler:
                 (trigger, key, tuple(n for e in entries for n in e.names)))
             self._inflight_until = time.monotonic() + (
                 _INFLIGHT_WINDOW_CYCLES * envs.cycle_time_ms() / 1e3)
+            if pipelined:
+                # Register svc names with the executor's guard set in the
+                # SAME critical section that removes them from q.names —
+                # a producer reusing a name can then never observe the
+                # window between the drain and the batch submission
+                # (enqueue's clash check reads both sets). _mu -> _exec_cv
+                # nesting is one-way; no path nests them in reverse.
+                svc_names = {n for e in entries if e.requests
+                             for n in e.names}
+                if svc_names:
+                    with self._exec_cv:
+                        self._exec_names.update(svc_names)
         _timeline.record_cycle_flush(trigger)
-        self._execute(q.spec, entries)
+        if not pipelined:
+            self._execute(q.spec, entries)
+            return
+        ticket = None
+        if (q.spec.svc is not None and q.spec.kind in ("allreduce",
+                                                       "broadcast")):
+            # Overlapped negotiation: submit the whole flush's requests
+            # NOW, at the rank-deterministic trigger point (preserving
+            # the PR-2 negotiation-order contract), and let the executor
+            # wait for the responses only when it reaches this batch —
+            # the KV round trip then runs under flush k's collective.
+            reqs = [r for e in entries for r in e.requests]
+            if reqs:
+                try:
+                    ticket = q.spec.svc.negotiate_many_submit(reqs)
+                except BaseException as exc:
+                    with self._exec_cv:  # batch never reaches the
+                        # executor; release its guard names
+                        self._exec_names.difference_update(
+                            n for e in entries for n in e.names)
+                        self._exec_cv.notify_all()
+                    self._fail_entries(entries, exc)
+                    hvd_logging.error(
+                        "fusion cycle negotiation submit failed: %s", exc)
+                    if not isinstance(exc, Exception):
+                        raise
+                    return
+        self._submit(_Batch(q.spec, entries, trigger, ticket))
 
     def flush_entry(self, entry: _Entry, trigger: str) -> None:
         if not entry.done and entry.queue_key is not None:
             self.flush_queue(entry.queue_key, trigger)
 
     def flush_all(self, trigger: str) -> None:
-        """Drain every queue in first-enqueue order (barrier / shutdown /
-        backpressure)."""
+        """Drain every queue in first-enqueue order, then quiesce the
+        pipelined executor (barrier / shutdown / backpressure): callers
+        of flush_all need everything *dispatched* on return — a barrier
+        psum issued before a still-queued flush's programs would break
+        the cross-process program issue order."""
         while True:
             with self._mu:
                 key = next(iter(self._queues), None)
             if key is None:
-                return
+                break
             self.flush_queue(key, trigger)
+        self.quiesce()
 
     def wait_result(self, entry: _Entry):
         """Synchronize path: flush the entry's queue if still pending,
@@ -288,71 +405,234 @@ class FusionScheduler:
         self.flush_entry(entry, "poll")
         return entry.done
 
+    # -- pipelined flush executor ------------------------------------------
+
+    def _submit(self, batch: _Batch) -> None:
+        # svc entry names were already registered in _exec_names by
+        # flush_queue, inside the same _mu section that drained them from
+        # q.names — THAT registration is the load-bearing one (no window
+        # for a reused name to slip through); this method only queues.
+        with self._exec_cv:
+            self._exec_q.append(batch)
+            self._pstats["submitted"] += 1
+            if self._exec_thread is None or not self._exec_thread.is_alive():
+                self._exec_stop = False
+                self._exec_thread = threading.Thread(
+                    target=self._exec_loop, daemon=True,
+                    name="hvd-flush-pipeline")
+                self._exec_thread.start()
+            self._exec_cv.notify_all()
+
+    def _exec_loop(self) -> None:
+        """The dedicated dispatch thread: one batch at a time, in strict
+        submission (FIFO) order — slot admission order derives from
+        submission order only, never from completion timing, so the flush
+        composition AND the collective program issue order are identical
+        for identical call streams (and concurrent collective launches,
+        which deadlock the backend rendezvous, cannot happen between two
+        queued flushes by construction)."""
+        while True:
+            with self._exec_cv:
+                while not self._exec_q:
+                    if self._exec_stop:
+                        return
+                    self._exec_cv.wait(0.5)
+                batch = self._exec_q.popleft()
+                self._exec_busy = True
+            try:
+                try:
+                    self._admit_slot()
+                except BaseException:
+                    # a failed earlier flush raises at block_until_ready;
+                    # its entries already carry results — the error
+                    # surfaces at THEIR synchronize, not this batch's
+                    self._exec_inflight.clear()
+                try:
+                    self._execute(batch.spec, batch.entries, batch.ticket)
+                except BaseException:
+                    # entries were already marked failed by _execute; a
+                    # KeyboardInterrupt on the daemon executor is spurious
+                    # and must not kill the pipeline
+                    hvd_logging.exception("pipelined flush failed")
+                try:
+                    self._track_inflight(batch.entries)
+                except BaseException:  # accounting must never stall the
+                    hvd_logging.exception("in-flight tracking failed")
+            finally:
+                with self._exec_cv:
+                    self._exec_busy = False
+                    self._pstats["executed"] += 1
+                    for e in batch.entries:
+                        if e.requests:
+                            self._exec_names.difference_update(e.names)
+                    self._exec_cv.notify_all()
+
+    def _admit_slot(self) -> None:
+        """Bound the in-flight window: at most ``HVD_MAX_INFLIGHT_FLUSHES``
+        dispatched-but-device-incomplete flushes. Admission past the
+        window blocks on the OLDEST in-flight flush (FIFO retirement —
+        completion timing never reorders anything)."""
+        import jax
+        slots = max(envs.max_inflight_flushes(), 1)
+        while self._exec_inflight and all(
+                getattr(l, "is_ready", lambda: True)()
+                for l in self._exec_inflight[0]):
+            self._exec_inflight.popleft()  # retire completed without blocking
+        waited = False
+        while len(self._exec_inflight) >= slots:
+            leaves = self._exec_inflight.popleft()
+            waited = True
+            jax.block_until_ready(leaves)  # GIL released: producers run on
+        depth = len(self._exec_inflight)
+        with self._exec_cv:
+            self._pstats["depth_sum"] += depth
+            if depth > 0:
+                self._pstats["overlapped"] += 1
+            if depth > self._pstats["inflight_peak"]:
+                self._pstats["inflight_peak"] = depth
+            if waited:
+                self._pstats["slot_waits"] += 1
+        _timeline.record_inflight_depth(depth)
+
+    def _track_inflight(self, entries: list[_Entry]) -> None:
+        import jax
+        leaves = []
+        for e in entries:
+            for r in (e.results or ()):
+                arr = getattr(r, "array", r)  # PerRank carries .array
+                leaves.extend(x for x in jax.tree.leaves(arr)
+                              if hasattr(x, "is_ready"))
+        self._exec_inflight.append(leaves)
+
+    def quiesce(self) -> None:
+        """Block until every submitted batch has been dispatched (entry
+        events set; device completion is the slots'/handles' business).
+        Safe to call with nothing pending; no-op from the executor thread
+        itself (an executor-side dispatch can never wait on itself)."""
+        if threading.current_thread() is self._exec_thread:
+            return
+        with self._exec_cv:
+            while self._exec_q or self._exec_busy:
+                self._exec_cv.wait(0.1)
+
+    def _wait_names_clear(self, names) -> None:
+        """Block until none of ``names`` is tracked as an in-flight svc
+        negotiation (name-reuse guard): covers the whole span from the
+        drain-side registration through batch execution — including the
+        submission window where the executor queue itself looks idle."""
+        if threading.current_thread() is self._exec_thread:
+            return
+        names = set(names)
+        with self._exec_cv:
+            while not self._exec_names.isdisjoint(names):
+                self._exec_cv.wait(0.05)
+
     # -- execution ---------------------------------------------------------
 
-    def _execute(self, spec: _QueueSpec, entries: list[_Entry]) -> None:
+    def _fail_entries(self, entries: list[_Entry], exc) -> None:
+        """Mark every undelivered entry so waiters unblock (the error
+        re-raises at synchronize())."""
+        for e in entries:
+            if not e.done:
+                e.error = exc
+                e.tensors = ()
+                e.run = None
+                e.event.set()
+
+    def _execute(self, spec: _QueueSpec, entries: list[_Entry],
+                 ticket=None) -> None:
         try:
             if spec.kind == "sparse":
-                self._execute_opaque(entries)
+                units = [[e] for e in entries]
+                self._dispatch_units(units, self._run_opaque_unit)
             elif spec.kind == "allgather":
-                self._execute_allgather(spec, entries)
+                units = [[e] for e in entries]
+                self._dispatch_units(
+                    units, lambda unit: self._run_allgather_unit(spec, unit))
             elif spec.svc is None:
-                self._execute_fused(spec, entries)
+                # Single-controller flush: ONE grouped dispatch for the
+                # whole queue, through the dispatch plan cache — repeated
+                # flush signatures go straight to the compiled programs.
+                self._dispatch_units(
+                    [entries], lambda unit: self._run_fused_unit(spec, unit))
             else:
-                self._execute_negotiated(spec, entries)
+                self._execute_negotiated(spec, entries, ticket)
         except BaseException as exc:
-            # Mark every undelivered entry so waiters unblock (the error
-            # re-raises at synchronize()).
-            for e in entries:
-                if not e.done:
-                    e.error = exc
-                    e.tensors = ()
-                    e.run = None
-                    e.event.set()
+            self._fail_entries(entries, exc)
             hvd_logging.error("fusion cycle flush failed: %s", exc)
             if not isinstance(exc, Exception):
                 # KeyboardInterrupt/SystemExit must interrupt the caller
                 # (user-thread flushes run inside enqueue/synchronize);
-                # the timer loop catches it separately and survives.
+                # the timer and executor loops catch it separately and
+                # survive.
                 raise
 
-    def _count_dispatch(self, n: int = 1) -> None:
+    def _dispatch_units(self, units, run_unit) -> None:
+        """THE shared dispatch helper: a flush is a list of wire dispatch
+        *units* (each a list of entries whose tensors travel together in
+        one wire batch). Single-controller flushes are one unit; the
+        multi-process allreduce path is one unit per entry (submission-
+        time composition, matching the joined-rank reconstruction);
+        allgather/sparse are per-entry by nature. Dispatch accounting is
+        therefore uniform across modes: ``dispatches`` counts FLUSH-level
+        dispatch rounds (so the coalesce ratio means the same thing in
+        single-controller and multi-process jobs) and ``wire_programs``
+        counts the actual program batches issued."""
+        for unit in units:
+            outs = run_unit(unit)
+            i = 0
+            for e in unit:
+                e.results = list(outs[i:i + e.count])
+                i += e.count
+                e.tensors = ()  # release inputs: handles keep results only
+                e.run = None
+                e.event.set()
         with self._mu:
-            self._stats["dispatches"] += n
+            self._stats["dispatches"] += 1
+            self._stats["wire_programs"] += len(units)
 
-    def _execute_fused(self, spec: _QueueSpec, entries: list[_Entry]) -> None:
-        """Single-controller flush: ONE grouped dispatch for the whole
-        queue, through the dispatch plan cache — repeated flush signatures
-        go straight to the compiled fused program."""
+    def _run_fused_unit(self, spec: _QueueSpec, unit: list[_Entry]) -> list:
         from . import collectives as _coll
-        tensors = [t for e in entries for t in e.tensors]
+        tensors = [t for e in unit for t in e.tensors]
         if spec.kind == "allreduce":
-            outs = _coll.grouped_allreduce(
+            return _coll.grouped_allreduce(
                 tensors, op=spec.op, process_set=spec.pset,
                 prescale_factor=spec.pre, postscale_factor=spec.post,
                 axis_name=spec.axis, compression=spec.compression)
-        else:  # broadcast
-            outs = _coll.grouped_broadcast(
-                tensors, spec.root_rank, process_set=spec.pset,
-                axis_name=spec.axis)
-        self._count_dispatch()
-        i = 0
-        for e in entries:
-            e.results = list(outs[i:i + e.count])
-            i += e.count
-            e.tensors = ()  # release the inputs: handles keep results only
-            e.event.set()
+        return _coll.grouped_broadcast(
+            tensors, spec.root_rank, process_set=spec.pset,
+            axis_name=spec.axis)
 
-    def _execute_negotiated(self, spec: _QueueSpec,
-                            entries: list[_Entry]) -> None:
+    def _run_allgather_unit(self, spec: _QueueSpec,
+                            unit: list[_Entry]) -> list:
+        """Allgather entries dispatch per-entry in submission order (the
+        engine's recv_splits can resize the program per call, so there is
+        no fused multi-tensor gather program to coalesce into); the queue
+        still defers them to the cycle so they overlap submission-side
+        Python with in-flight device work."""
+        from . import collectives as _coll
+        e, = unit
+        return [_coll.allgather(e.tensors[0], process_set=spec.pset,
+                                axis_name=spec.axis, name=e.names[0])]
+
+    def _run_opaque_unit(self, unit: list[_Entry]) -> list:
+        e, = unit
+        return [e.run()]
+
+    def _execute_negotiated(self, spec: _QueueSpec, entries: list[_Entry],
+                            ticket=None) -> None:
         """Multi-process flush: batch ALL drained negotiations into one
         ``negotiate_many`` round (one KV cycle per flush instead of one
-        per call), then execute each entry with its submission-time
-        program composition — identical to what a joined rank rebuilds
-        from response metadata, so programs match across processes no
-        matter when each process's cycle fired."""
+        per call — submitted early by the pipelined flush trigger, waited
+        here), then execute each entry with its submission-time program
+        composition — identical to what a joined rank rebuilds from
+        response metadata, so programs match across processes no matter
+        when each process's cycle fired."""
         from . import collectives as _coll
-        spec.svc.negotiate_many([r for e in entries for r in e.requests])
+        if ticket is not None:
+            spec.svc.negotiate_many_wait(ticket)
+        else:
+            spec.svc.negotiate_many([r for e in entries for r in e.requests])
         if spec.kind == "broadcast":
             # Broadcast is illegal while any rank is joined (reference
             # JoinOp covers allreduce/allgather/barrier only), so there is
@@ -360,49 +640,20 @@ class FusionScheduler:
             # flushed queue fuses into one dispatch, like single-
             # controller mode (flush points are rank-deterministic, so
             # every process fuses the identical set).
-            tensors = [t for e in entries for t in e.tensors]
-            outs = _coll._run_queued_broadcast(
-                tensors, spec.pset, spec.axis, spec.root_rank,
-                entries[0].label)
-            self._count_dispatch()
-            i = 0
-            for e in entries:
-                e.results = list(outs[i:i + e.count])
-                i += e.count
-                e.tensors = ()
-                e.event.set()
+            def run_bcast(unit):
+                tensors = [t for e in unit for t in e.tensors]
+                return _coll._run_queued_broadcast(
+                    tensors, spec.pset, spec.axis, spec.root_rank,
+                    unit[0].label)
+            self._dispatch_units([entries], run_bcast)
             return
-        for e in entries:
-            e.results = _coll._run_queued_allreduce(
+
+        def run_entry(unit):
+            e, = unit
+            return _coll._run_queued_allreduce(
                 e.tensors, spec.pset, spec.axis, spec.op, spec.pre,
                 spec.post, spec.compression, e.label)
-            self._count_dispatch()
-            e.tensors = ()
-            e.event.set()
-
-    def _execute_allgather(self, spec: _QueueSpec,
-                           entries: list[_Entry]) -> None:
-        """Allgather entries dispatch per-entry in submission order (the
-        engine's recv_splits can resize the program per call, so there is
-        no fused multi-tensor gather program to coalesce into); the queue
-        still defers them to the cycle so they overlap submission-side
-        Python with in-flight device work."""
-        from . import collectives as _coll
-        for e in entries:
-            e.results = [_coll.allgather(e.tensors[0], process_set=spec.pset,
-                                         axis_name=spec.axis,
-                                         name=e.names[0])]
-            self._count_dispatch()
-            e.tensors = ()
-            e.event.set()
-
-    def _execute_opaque(self, entries: list[_Entry]) -> None:
-        for e in entries:
-            e.results = [e.run()]
-            self._count_dispatch()
-            e.tensors = ()
-            e.run = None  # the closure holds the input rows
-            e.event.set()
+        self._dispatch_units([[e] for e in entries], run_entry)
 
     # -- cycle timer -------------------------------------------------------
 
@@ -471,22 +722,50 @@ class FusionScheduler:
     def abort(self, reason: str) -> int:
         """Fail everything still pending without executing (engine
         service reset / elastic world teardown — the world the entries
-        were negotiated against no longer exists). Returns the number of
-        entries aborted; their handles raise at synchronize()."""
+        were negotiated against no longer exists): the pending queues AND
+        the batches sitting in the pipelined executor's submission queue
+        (their negotiation tickets are cancelled so the names become
+        reusable). The batch the executor is currently dispatching runs
+        to completion or error on its own — its entries' events are set
+        either way, so no waiter can deadlock on an abort mid-pipeline.
+        Returns the number of entries aborted; their handles raise at
+        synchronize()."""
         with self._mu:
             queues = list(self._queues.values())
             self._queues.clear()
             self._pending_tensors = 0
             self._pending_bytes = 0
+        with self._exec_cv:
+            batches = list(self._exec_q)
+            self._exec_q.clear()
+            for b in batches:
+                for e in b.entries:
+                    if e.requests:
+                        self._exec_names.difference_update(e.names)
+            self._exec_cv.notify_all()
         n = 0
+        err = lambda e: RuntimeError(
+            f"queued collective {e.label!r} aborted: {reason}")
         for q in queues:
             for e in q.entries:
-                e.error = RuntimeError(
-                    f"queued collective {e.label!r} aborted: {reason}")
+                e.error = err(e)
                 e.tensors = ()
                 e.run = None
                 e.event.set()
                 n += 1
+        for b in batches:
+            if b.ticket is not None:
+                try:
+                    b.spec.svc.negotiate_many_cancel(b.ticket)
+                except Exception:
+                    pass  # service may already be gone
+            for e in b.entries:
+                if not e.done:
+                    e.error = err(e)
+                    e.tensors = ()
+                    e.run = None
+                    e.event.set()
+                    n += 1
         return n
 
     def stop(self) -> None:
@@ -496,8 +775,41 @@ class FusionScheduler:
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5)
         self._thread = None
+        with self._exec_cv:
+            self._exec_stop = True
+            self._exec_cv.notify_all()
+        t = self._exec_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._exec_thread = None
+        self._exec_inflight.clear()
 
     def stats(self) -> dict:
+        slots = max(envs.max_inflight_flushes(), 1)
+        with self._exec_cv:
+            executed = self._pstats["executed"]
+            pipeline = {
+                "enabled": envs.pipeline_enabled(),
+                "max_inflight": envs.max_inflight_flushes(),
+                "chunking": envs.pipeline_chunking_enabled(),
+                "pipeline_threshold_bytes": envs.pipeline_threshold_bytes(),
+                "pipeline_chunks": envs.pipeline_chunks(),
+                "submitted": self._pstats["submitted"],
+                "executed": executed,
+                "queue_depth": len(self._exec_q),
+                "inflight_peak": self._pstats["inflight_peak"],
+                "slot_waits": self._pstats["slot_waits"],
+                # fraction of flushes dispatched while >=1 earlier flush
+                # was still in flight on device — the overlap the
+                # executor exists to create
+                "overlap_ratio": (self._pstats["overlapped"] / executed
+                                  if executed else 0.0),
+                # mean fraction of the slot window occupied at admission
+                # (the admitted batch itself counts as one slot)
+                "slot_occupancy": (
+                    (self._pstats["depth_sum"] / executed + 1.0) / slots
+                    if executed else 0.0),
+            }
         with self._mu:
             flushes = dict(self._stats["flushes"])
             dispatches = self._stats["dispatches"]
@@ -517,15 +829,21 @@ class FusionScheduler:
                 "flushed_tensors": flushed,
                 "flushed_bytes": self._stats["flushed_bytes"],
                 "dispatches": dispatches,
+                "wire_programs": self._stats["wire_programs"],
                 "tensors_per_flush": (flushed / total_flushes
                                       if total_flushes else 0.0),
                 "bytes_per_flush": (self._stats["flushed_bytes"]
                                     / total_flushes if total_flushes
                                     else 0.0),
-                # tensors coalesced per wire dispatch — the headline
-                # number: N small async calls -> N/coalesce dispatches
+                # tensors coalesced per flush-level dispatch round — the
+                # headline number: N small async calls -> N/coalesce
+                # dispatches. Uniform across modes: a multi-process flush
+                # is ONE dispatch round (one negotiate_many batch) even
+                # though its submission-time composition issues one wire
+                # program per entry (see wire_programs).
                 "coalesce_ratio": (flushed / dispatches if dispatches
                                    else 0.0),
+                "pipeline": pipeline,
             }
 
     def reset_stats(self) -> None:
@@ -533,9 +851,15 @@ class FusionScheduler:
             self._stats = {
                 "enqueued_tensors": 0, "enqueued_bytes": 0,
                 "flushed_tensors": 0, "flushed_bytes": 0, "dispatches": 0,
+                "wire_programs": 0,
                 "flushes": {t: 0 for t in FLUSH_TRIGGERS},
             }
             self.flush_history.clear()
+        with self._exec_cv:
+            self._pstats = {
+                "submitted": 0, "executed": 0, "overlapped": 0,
+                "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
+            }
 
 
 # ---------------------------------------------------------------------------
@@ -766,6 +1090,17 @@ def flush_all(trigger: str = "barrier") -> None:
     sched = _scheduler
     if sched is not None:
         sched.flush_all(trigger)
+
+
+def fusion_flush() -> None:
+    """User-visible flush point (exported as ``hvd.fusion_flush()``):
+    drain every pending queue into the pipelined executor and wait until
+    all of it is *dispatched*. Weaker than ``hvd.barrier()`` — no
+    cross-rank rendezvous and no device-completion wait (synchronize a
+    handle for that) — and useful before timing boundaries or memory
+    checkpoints where queued-but-undispatched work would skew the
+    measurement."""
+    flush_all("barrier")
 
 
 def drain() -> None:

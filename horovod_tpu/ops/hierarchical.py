@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import runtime
 from ..utils import envs
+from .program_issue import issue_serialized as _issue_serialized
 from .reduce_ops import ReduceOp
 
 DCN_AXIS = "hvd_dcn"
@@ -159,9 +160,9 @@ def _eager_hier_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float, post: float,
 
     in_spec = P((dcn_axis, ici_axis)) if bundled else P()
     out_spec = P() if (row0 or not bundled) else in_spec
-    return jax.jit(jax.shard_map(
+    return _issue_serialized(jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-        check_vma=False))
+        check_vma=False)))
 
 
 def _hier_grouped_allreduce_smap(mesh: Mesh, op: ReduceOp, pre: float,
@@ -194,9 +195,9 @@ def _eager_hier_grouped_allreduce_fn(mesh: Mesh, op: ReduceOp, pre: float,
                                      post: float, num_bufs: int,
                                      bundled: bool = True,
                                      donate: tuple = ()):
-    return jax.jit(
+    return _issue_serialized(jax.jit(
         _hier_grouped_allreduce_smap(mesh, op, pre, post, num_bufs, bundled),
-        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -207,9 +208,9 @@ def _eager_hier_allgather_fn(mesh: Mesh, bundled: bool = True):
         return hierarchical_allgather_traced(x[0] if bundled else x,
                                              ici_axis, dcn_axis)
 
-    return jax.jit(jax.shard_map(
+    return _issue_serialized(jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)) if bundled else P(),
-        out_specs=P(), check_vma=False))
+        out_specs=P(), check_vma=False)))
 
 
 def _enabled(knob: str, pset) -> bool:

@@ -1,0 +1,42 @@
+"""Cross-thread serialization of collective program *issue*.
+
+With the pipelined flush executor (``ops/fusion_cycle.py``) a dedicated
+thread dispatches queued collectives while user threads may concurrently
+dispatch synchronous ones. Two multi-device collective programs whose
+per-device enqueues interleave can deadlock the backend's collective
+rendezvous — reproduced on the XLA CPU backend: two ``psum`` launches
+from two threads each ended up waiting forever for participants that were
+stuck inside the *other* launch (device 0 ran program A's participant
+while device 1 ran program B's, and neither rendezvous could complete).
+The reference runtime has the same invariant one layer down: all NCCL
+launches happen on the single background thread (``operations.cc:385``).
+
+The fix is a process-wide issue lock held around the *enqueue* of every
+eager collective program. JAX dispatch is asynchronous — the lock covers
+only the host-side enqueue (microseconds to low milliseconds), never
+device execution or completion waits, so it serializes program ORDER
+without serializing the work. This also gives multi-threaded eager
+callers a well-defined cross-process program issue order, which the
+multi-process determinism contract requires.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# RLock: a wrapped program is never called from inside another wrapped
+# program (compositions happen at trace time), but re-entrancy is cheap
+# insurance against future nesting.
+_ISSUE_LOCK = threading.RLock()
+
+
+def issue_serialized(fn):
+    """Wrap a compiled (jitted) program so concurrent callers enqueue
+    their device work atomically. Returns a plain closure; the wrapped
+    callable's only contract is ``__call__``."""
+
+    def call(*args, **kwargs):
+        with _ISSUE_LOCK:
+            return fn(*args, **kwargs)
+
+    return call

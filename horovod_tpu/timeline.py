@@ -31,6 +31,8 @@ _atexit_registered = False
 NEGOTIATE = "NEGOTIATE"
 QUEUE_ENQUEUE = "QUEUE_ENQUEUE"
 CYCLE_FLUSH = "CYCLE_FLUSH"
+PIPELINE_LANE = "pipeline"
+INFLIGHT_DEPTH = "INFLIGHT_DEPTH"
 PHASE_BEGIN = 0
 PHASE_END = 1
 PHASE_INSTANT = 2
@@ -129,6 +131,26 @@ def record_cycle_flush(trigger: str) -> None:
     so coalescing behavior is visible next to the op ranges."""
     if _active:
         record("fusion_cycle", f"{CYCLE_FLUSH}.{trigger}", PHASE_INSTANT)
+
+
+def record_inflight_depth(depth: int) -> None:
+    """Instant ``INFLIGHT_DEPTH.<n>`` marker on the ``pipeline`` lane when
+    the flush executor admits a batch: ``n`` is how many earlier flushes
+    are still in flight on device, so slot occupancy (and bubbles — long
+    stretches at depth 0) read straight off the trace."""
+    if _active:
+        record(PIPELINE_LANE, f"{INFLIGHT_DEPTH}.{int(depth)}",
+               PHASE_INSTANT)
+
+
+def pipeline_stage(stage: str) -> "op_range":
+    """Span on the ``pipeline`` lane around one stage of a chunked flush
+    (``PIPELINE_FUSE`` / ``PIPELINE_DISPATCH`` / ``PIPELINE_SPLIT``) —
+    the software-pipeline twin of the per-op ranges. The spans cover the
+    *host-side dispatch* of each stage (device execution is asynchronous);
+    overlap shows as DISPATCH spans packed back-to-back while earlier
+    chunks' collectives are still in flight."""
+    return op_range(PIPELINE_LANE, f"PIPELINE_{stage}")
 
 
 def record(tensor: str, activity: str, phase: int) -> None:

@@ -41,6 +41,10 @@ HIERARCHICAL_ICI_SIZE = "HIERARCHICAL_ICI_SIZE"  # chips per ICI island; default
 ADAPTIVE_CYCLE = "ADAPTIVE_CYCLE"  # event-driven negotiation tick (default on)
 PENDING_CYCLE_TIME = "PENDING_CYCLE_TIME"  # ms; cycle floor while work is in flight
 FUSION_MAX_PENDING = "FUSION_MAX_PENDING"  # bytes; fusion-cycle backpressure cap (default 4x FUSION_THRESHOLD)
+MAX_INFLIGHT_FLUSHES = "MAX_INFLIGHT_FLUSHES"  # pipelined flush executor slots (0/1 = synchronous)
+PIPELINE_THRESHOLD = "PIPELINE_THRESHOLD"  # bytes; fused wire buffers past this split into chunks
+PIPELINE_CHUNKS = "PIPELINE_CHUNKS"  # chunk count for the large-buffer software pipeline
+PIPELINE_PINGPONG = "PIPELINE_PINGPONG"  # auto|1|0: recycle wire buffers across flushes via donation
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
 GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
@@ -171,3 +175,61 @@ def cycle_time_ms() -> float:
 
 def cache_capacity() -> int:
     return get_int(CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
+
+
+# Pipelined flush executor defaults. Two in-flight slots are the classic
+# double-buffering point: flush k+1's host-side fuse/negotiation overlaps
+# flush k's device collective without unbounded device-queue growth. The
+# 4 MiB / 4-chunk pipeline splits a large fused wire buffer into chunk
+# programs so the collective of chunk i overlaps the fuse/split (and, on
+# the CPU mesh, the per-device execution) of its neighbors.
+DEFAULT_MAX_INFLIGHT_FLUSHES = 2
+DEFAULT_PIPELINE_THRESHOLD_BYTES = 4 * 1024 * 1024
+DEFAULT_PIPELINE_CHUNKS = 4
+
+
+def max_inflight_flushes() -> int:
+    return get_int(MAX_INFLIGHT_FLUSHES, DEFAULT_MAX_INFLIGHT_FLUSHES)
+
+
+def pipeline_enabled() -> bool:
+    """The pipelined flush executor is engaged at >= 2 slots; 0/1 keep the
+    synchronous (execute-on-the-triggering-thread) behavior byte-for-byte."""
+    return max_inflight_flushes() >= 2
+
+
+def pipeline_threshold_bytes() -> int:
+    return get_int(PIPELINE_THRESHOLD, DEFAULT_PIPELINE_THRESHOLD_BYTES)
+
+
+def pipeline_chunks() -> int:
+    return get_int(PIPELINE_CHUNKS, DEFAULT_PIPELINE_CHUNKS)
+
+
+def pipeline_chunking_enabled() -> bool:
+    """Large-buffer chunk pipelining rides the pipelined executor: it is
+    part of the same overlap mechanism, and disabling the executor
+    (MAX_INFLIGHT_FLUSHES<=1) must restore the exact pre-pipeline
+    program compositions."""
+    return (pipeline_enabled() and pipeline_threshold_bytes() > 0
+            and pipeline_chunks() >= 2)
+
+
+def donation_effective(platform: str) -> bool:
+    """Whether buffer donation actually recycles memory on this backend.
+    The CPU backend ignores donation while still paying per-call
+    bookkeeping for it, so donation-dependent optimizations gate on
+    this."""
+    return platform not in ("cpu",)
+
+
+def pipeline_pingpong_enabled(platform: str) -> bool:
+    """Ping-pong wire-buffer recycling needs real buffer donation; the CPU
+    backend ignores donation, turning each recycle output into a copy —
+    'auto' therefore enables it off-CPU only."""
+    val = (get(PIPELINE_PINGPONG, "auto") or "auto").strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    return donation_effective(platform)
