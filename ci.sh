@@ -22,13 +22,19 @@ step() { echo; echo "=== $* ==="; }
 step "0/6 native build from source (no committed binaries)"
 python -c "from horovod_tpu._native import build_native; print(build_native(force=True))"
 
+step "0a/6 hvdlint static analysis gate (project invariants; docs/static_analysis.md)"
+# AST-only, no jax import: the cheapest gate runs first. Any finding
+# (issue-lock / lock-order / timer-purity / knob-registry / donation)
+# fails the build.
+python -m tools.hvdlint horovod_tpu
+
 # Pass-count floor for the tier-1 gate. The 13 multi-process spawn tests
 # that fail on jax builds whose CPU backend lacks cross-process
 # computations ("Multiprocess computations aren't implemented on the CPU
 # backend") are now SKIPPED via tests/backend_markers.py, so the dot
 # count is a clean signal. Raise this when the environment's pass level
 # rises; override with T1_MIN_PASSED.
-T1_MIN_PASSED="${T1_MIN_PASSED:-427}"
+T1_MIN_PASSED="${T1_MIN_PASSED:-474}"
 
 step "1/6 tier-1 gate (the ROADMAP.md command; floor: $T1_MIN_PASSED passed)"
 # faulthandler_timeout: a hung test (e.g. a flush-executor deadlock) dumps
@@ -88,6 +94,15 @@ fi
 
 step "1b/6 test suite, second pass (flake detection)"
 python -m pytest tests/ -q -x -o faulthandler_timeout=300
+
+step "1e/6 concurrency invariant checker (threaded stress suites under HVD_DEBUG_INVARIANTS=1)"
+# The dev-mode runtime checker (utils/invariants.py): lock-order witness,
+# thread-affinity assertions, enqueue-reentrancy guard. The threaded
+# stress tests must complete with zero invariant reports — a violation
+# raises and fails the run.
+env HVD_DEBUG_INVARIANTS=1 timeout -k 10 600 \
+  python -m pytest tests/test_pipeline_flush.py tests/test_fusion_cycle.py \
+    tests/test_invariants.py -q -o faulthandler_timeout=300
 
 step "2/6 driver artifact: single-chip compile check (entry)"
 python - <<'EOF'

@@ -20,7 +20,6 @@ backend before ``jax.distributed`` accepts a new world definition.
 
 from __future__ import annotations
 
-import os
 import pickle
 import sys
 import time
@@ -59,7 +58,7 @@ class WorkerRendezvous:
         self.hostname = envs.get(envs.HOSTNAME) or "localhost"
         # Stable worker identity: the local slot index assigned at spawn.
         self.slot = envs.get_int(envs.LOCAL_RANK, 0)
-        self.round = int(os.environ.get("HVD_ELASTIC_ROUND", "1"))
+        self.round = envs.get_int(envs.ELASTIC_ROUND, 1)
         self.timeout = envs.get_int(envs.ELASTIC_TIMEOUT, 600)
 
     # -- protocol ----------------------------------------------------------
@@ -160,7 +159,7 @@ class WorkerRendezvous:
             envs.COORDINATOR_PORT: spec["coord_port"],
         }
         for name, value in env.items():
-            os.environ["HVD_" + name] = str(value)
+            envs.set_env(name, value)
 
         self.round = spec["round"]
         runtime.init()

@@ -475,7 +475,7 @@ def get_service(pset=None) -> DynamicService | None:
     global _service_unavailable
     if _service_unavailable:
         return None
-    if not envs.get_bool("DYNAMIC_ENGINE", True):
+    if not envs.get_bool(envs.DYNAMIC_ENGINE, True):
         _service_unavailable = True
         return None
     from . import runtime

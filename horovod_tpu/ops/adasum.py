@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..process_sets import ProcessSet, _resolve
+from .program_issue import issue_serialized as _issue_serialized
 
 
 def _coeffs(dot, na, nb):
@@ -212,8 +213,13 @@ def adasum_hierarchical_traced(x, ici_axis, dcn_axis):
 def _eager_adasum_fn(mesh: Mesh, axis: str):
     def inner(x):  # (1, ...) bundle shard
         return adasum_reduce(x, axis)
-    return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False))
+    # issue_serialized: eager multi-device collectives must enqueue under
+    # the process-wide issue lock (PR-3 deadlock class; ops/program_issue).
+    # These two sites predate the lock and were flagged by hvdlint's
+    # issue-lock pass.
+    return _issue_serialized(jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -223,9 +229,9 @@ def _eager_hier_adasum_fn(mesh: Mesh):
     def inner(x):  # (1, ...) bundle shard over the 2-D mesh
         return adasum_hierarchical_traced(x[0], ici_axis, dcn_axis)[None]
 
-    return jax.jit(jax.shard_map(
+    return _issue_serialized(jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=P((dcn_axis, ici_axis)),
-        out_specs=P((dcn_axis, ici_axis)), check_vma=False))
+        out_specs=P((dcn_axis, ici_axis)), check_vma=False)))
 
 
 def adasum_allreduce(tensor, *, process_set: ProcessSet | None = None,

@@ -1454,7 +1454,10 @@ def _execute_allreduce_bundle(bundle, pset, axis, lowered_op, pre, post):
     return fn(bundle)[0]
 
 
-def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,
+# timer-boundary: the fusion-cycle timer only flushes single-controller
+# queues (svc is None -> no negotiation, composition trivially rank-
+# consistent), so timer-purity traversal stops at this entry point.
+def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,  # hvdlint: timer-boundary
                       process_set: ProcessSet | None = None,
                       prescale_factor: float = 1.0, postscale_factor: float = 1.0,
                       name: str | None = None, axis_name=None,
@@ -1626,7 +1629,10 @@ def _execute_grouped_bundles(bundles, pset, axis, lowered_op, pre, post,
     return _split_fused([buf[0] for buf in fused_outputs], metas, count)
 
 
-def allgather(tensor, *, process_set: ProcessSet | None = None,
+# timer-boundary: the fusion-cycle timer never flushes svc allgather
+# queues (_loop skips svc queues), and the single-controller path below
+# has no negotiation — traversal stops here.
+def allgather(tensor, *, process_set: ProcessSet | None = None,  # hvdlint: timer-boundary
               name: str | None = None, axis_name=None):
     """Allgather: concatenate per-rank tensors along dim 0 (reference
     ``hvd.allgather``; ``EnqueueTensorAllgather`` at ``operations.cc:1529``,
@@ -1804,7 +1810,9 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
         return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
 
 
-def grouped_broadcast(tensors: Sequence, root_rank: int, *,
+# timer-boundary: see grouped_allreduce — timer flushes are single-
+# controller only, so no negotiation is reachable through this entry.
+def grouped_broadcast(tensors: Sequence, root_rank: int, *,  # hvdlint: timer-boundary
                       process_set: ProcessSet | None = None,
                       name: str | None = None, axis_name=None):
     """Fused broadcast of a tensor list from ``root_rank``. Eager mode packs
@@ -2390,7 +2398,9 @@ def synchronize(handle: Handle):
 # -- queued-entry executors (multi-process flush path: negotiation already
 #    batched by the scheduler, program composition = submission-time) -------
 
-def _run_queued_allreduce(tensors, pset: ProcessSet, axis, op: ReduceOp,
+# timer-boundary: queued-entry executors only run for svc-backed flushes,
+# which the cycle timer never drains (rank-deterministic triggers only).
+def _run_queued_allreduce(tensors, pset: ProcessSet, axis, op: ReduceOp,  # hvdlint: timer-boundary
                           pre_f: float, post_f: float, compression,
                           label: str) -> list:
     """Execute one queued allreduce entry (single tensor or atomic group)
@@ -2419,7 +2429,7 @@ def _run_queued_allreduce(tensors, pset: ProcessSet, axis, op: ReduceOp,
                                         wire_dtypes=wire_dts)
 
 
-def _run_queued_broadcast(tensors, pset: ProcessSet, axis, root_rank: int,
+def _run_queued_broadcast(tensors, pset: ProcessSet, axis, root_rank: int,  # hvdlint: timer-boundary
                           label: str) -> list:
     """Execute one queued broadcast entry (submission-time composition;
     see :func:`_run_queued_allreduce`)."""

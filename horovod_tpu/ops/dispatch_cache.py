@@ -37,13 +37,13 @@ flush cheap enough to sit on the async hot path).
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Callable
 
 from .. import autotune as _autotune
 from .. import timeline as _timeline
 from ..utils import envs
+from ..utils import invariants as _inv
 
 
 class DispatchPlan:
@@ -89,7 +89,7 @@ class DispatchPlan:
 # attempt AND the miss counter.
 UNPLANNABLE = object()
 
-_lock = threading.Lock()
+_lock = _inv.make_lock("dispatch_cache.lock")
 _plans: "OrderedDict[tuple, DispatchPlan]" = OrderedDict()
 _epoch: tuple | None = None
 _hits = 0
@@ -117,6 +117,7 @@ def _current_epoch() -> tuple:
 
 def _flush_locked(count_invalidation: bool) -> None:
     global _invalidations
+    _inv.assert_holding(_lock, "dispatch_cache plan-map flush")
     if count_invalidation:
         _invalidations += len(_plans)
     _plans.clear()

@@ -470,4 +470,4 @@ def supported() -> bool:
     control for future tuning."""
     from ..utils import envs
     return (jax.default_backend() == "tpu"
-            and envs.get_bool("FLASH_ATTENTION"))
+            and envs.get_bool(envs.FLASH_ATTENTION))

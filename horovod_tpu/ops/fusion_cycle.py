@@ -99,6 +99,7 @@ import numpy as np
 from .. import autotune as _autotune
 from .. import timeline as _timeline
 from ..utils import envs
+from ..utils import invariants as _inv
 from ..utils import logging as hvd_logging
 
 FLUSH_TRIGGERS = ("threshold", "cycle", "synchronize", "poll", "barrier",
@@ -215,7 +216,7 @@ class FusionScheduler:
     tests instantiate fresh ones to check composition determinism."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = _inv.make_lock("fusion_cycle.scheduler.mu")
         self._queues: "OrderedDict[tuple, _Queue]" = OrderedDict()
         self._pending_tensors = 0
         self._pending_bytes = 0
@@ -236,7 +237,7 @@ class FusionScheduler:
         # record the determinism tests compare across schedulers.
         self.flush_history: deque = deque(maxlen=64)
         # -- pipelined flush executor state (see _exec_loop) --
-        self._exec_cv = threading.Condition(threading.Lock())
+        self._exec_cv = _inv.make_condition("fusion_cycle.scheduler.exec_cv")
         self._exec_q: "deque[_Batch]" = deque()
         self._exec_busy = False
         self._exec_stop = False
@@ -251,6 +252,10 @@ class FusionScheduler:
     # -- enqueue -----------------------------------------------------------
 
     def enqueue(self, key: tuple, spec: _QueueSpec, entry: _Entry) -> None:
+        # A flush execution must never re-enter the scheduler: on the
+        # synchronous path it would self-deadlock on _mu, on the pipelined
+        # path it would corrupt flush composition mid-drain.
+        _inv.assert_outside("fusion-cycle-flush", "FusionScheduler.enqueue")
         entry.queue_key = key
         if entry.requests:
             # Multi-process entries negotiate the whole flush in ONE
@@ -276,6 +281,7 @@ class FusionScheduler:
                 # submission, where the executor queue looks idle.
                 self._wait_names_clear(entry.names)
         with self._mu:
+            _inv.assert_holding(self._mu, "pending-queue mutation (enqueue)")
             q = self._queues.get(key)
             if q is None:
                 q = _Queue(spec)
@@ -316,6 +322,7 @@ class FusionScheduler:
         executes inline, the pre-pipeline behavior."""
         pipelined = envs.pipeline_enabled()
         with self._mu:
+            _inv.assert_holding(self._mu, "pending-queue mutation (drain)")
             q = self._queues.pop(key, None)
             if q is None or not q.entries:
                 return
@@ -356,7 +363,11 @@ class FusionScheduler:
             reqs = [r for e in entries for r in e.requests]
             if reqs:
                 try:
-                    ticket = q.spec.svc.negotiate_many_submit(reqs)
+                    # Statically reachable from the cycle timer, but the
+                    # timer never flushes svc queues (_loop skips them);
+                    # only rank-deterministic user-thread triggers reach
+                    # this negotiation submit.
+                    ticket = q.spec.svc.negotiate_many_submit(reqs)  # hvdlint: disable=timer-purity
                 except BaseException as exc:
                     with self._exec_cv:  # batch never reaches the
                         # executor; release its guard names
@@ -473,6 +484,11 @@ class FusionScheduler:
         window blocks on the OLDEST in-flight flush (FIFO retirement —
         completion timing never reorders anything)."""
         import jax
+        # The in-flight window deque is executor-private state: only the
+        # single dispatch thread may touch it (stop() clears it after the
+        # thread is joined).
+        _inv.assert_thread(self._exec_thread,
+                           "in-flight window admission (_admit_slot)")
         slots = max(envs.max_inflight_flushes(), 1)
         while self._exec_inflight and all(
                 getattr(l, "is_ready", lambda: True)()
@@ -496,6 +512,8 @@ class FusionScheduler:
 
     def _track_inflight(self, entries: list[_Entry]) -> None:
         import jax
+        _inv.assert_thread(self._exec_thread,
+                           "in-flight window tracking (_track_inflight)")
         leaves = []
         for e in entries:
             for r in (e.results or ()):
@@ -541,6 +559,11 @@ class FusionScheduler:
 
     def _execute(self, spec: _QueueSpec, entries: list[_Entry],
                  ticket=None) -> None:
+        with _inv.section("fusion-cycle-flush"):
+            self._execute_inner(spec, entries, ticket)
+
+    def _execute_inner(self, spec: _QueueSpec, entries: list[_Entry],
+                       ticket=None) -> None:
         try:
             if spec.kind == "sparse":
                 units = [[e] for e in entries]
@@ -629,10 +652,14 @@ class FusionScheduler:
         response metadata, so programs match across processes no matter
         when each process's cycle fired."""
         from . import collectives as _coll
+        # Both negotiation calls are timer-unreachable at runtime: _loop
+        # skips svc queues, so only user-thread triggers (rank-
+        # deterministic program points) drain negotiated flushes.
         if ticket is not None:
-            spec.svc.negotiate_many_wait(ticket)
+            spec.svc.negotiate_many_wait(ticket)  # hvdlint: disable=timer-purity
         else:
-            spec.svc.negotiate_many([r for e in entries for r in e.requests])
+            spec.svc.negotiate_many(  # hvdlint: disable=timer-purity
+                [r for e in entries for r in e.requests])
         if spec.kind == "broadcast":
             # Broadcast is illegal while any rank is joined (reference
             # JoinOp covers allreduce/allgather/barrier only), so there is

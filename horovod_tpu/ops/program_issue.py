@@ -22,12 +22,23 @@ multi-process determinism contract requires.
 
 from __future__ import annotations
 
-import threading
+from ..utils import invariants as _inv
 
 # RLock: a wrapped program is never called from inside another wrapped
 # program (compositions happen at trace time), but re-entrancy is cheap
-# insurance against future nesting.
-_ISSUE_LOCK = threading.RLock()
+# insurance against future nesting. Witness-tracked under
+# HVD_DEBUG_INVARIANTS so program issue participates in the lock-order
+# graph (docs/static_analysis.md).
+_ISSUE_LOCK = _inv.make_rlock("program_issue.issue")
+
+
+def issue_lock_held() -> bool:
+    """Whether the current thread is inside a serialized program issue.
+    The section counter is always maintained, so this works with the
+    checker off too; the lock-based half additionally covers direct
+    ``_ISSUE_LOCK`` holders when ``HVD_DEBUG_INVARIANTS=1`` makes the
+    RLock witness-tracked (plain RLocks don't expose their owner)."""
+    return _inv.holding(_ISSUE_LOCK) or _inv.inside("program-issue")
 
 
 def issue_serialized(fn):
@@ -36,7 +47,7 @@ def issue_serialized(fn):
     callable's only contract is ``__call__``."""
 
     def call(*args, **kwargs):
-        with _ISSUE_LOCK:
+        with _ISSUE_LOCK, _inv.section("program-issue"):
             return fn(*args, **kwargs)
 
     return call

@@ -37,7 +37,7 @@ from jax import lax
 from ..utils import envs
 from .reduce_ops import ReduceOp
 
-SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # HVD_SPARSE_AS_DENSE
+SPARSE_AS_DENSE = envs.SPARSE_AS_DENSE  # backcompat alias; HVD_SPARSE_AS_DENSE
 
 
 class SparseRows(typing.NamedTuple):
@@ -136,7 +136,7 @@ def sparse_allreduce_to_dense(grad, max_rows: int, *,
     replacement for a dense allreduce of an embedding gradient. With
     ``HVD_SPARSE_AS_DENSE`` set, skips row extraction and runs a regular
     dense allreduce (the reference's ``sparse_as_dense`` escape hatch)."""
-    if envs.get_bool(SPARSE_AS_DENSE):
+    if envs.get_bool(envs.SPARSE_AS_DENSE):
         from . import collectives
         return collectives.allreduce(grad, op=op, process_set=process_set,
                                      axis_name=axis_name, name=name)

@@ -27,6 +27,7 @@ import threading
 from . import hosts as hosts_mod
 from . import safe_exec
 from .http_kv import KVServer, local_addresses, make_secret
+from ..utils import envs
 from ..version import __version__
 
 SSH_OPTIONS = ["-o", "PasswordAuthentication=no",
@@ -448,7 +449,7 @@ def run_commandline(argv=None) -> int:
         print("hvdrun: no command given", file=sys.stderr)
         return 2
     if args.verbose:
-        os.environ.setdefault("HVD_LOG_LEVEL", "debug")
+        envs.set_env(envs.LOG_LEVEL, "debug", only_if_unset=True)
     elastic = args.host_discovery_script or args.min_np or args.max_np
     if elastic:
         try:

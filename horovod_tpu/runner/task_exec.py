@@ -8,21 +8,21 @@ results through the rendezvous KVStore the same way,
 
 from __future__ import annotations
 
-import os
 import sys
 import traceback
 
 import cloudpickle
 
+from ..utils import envs
 from .http_kv import KVClient
 
 
 def main() -> int:
-    rank = int(os.environ["HVD_RANK"])
-    client = KVClient(os.environ["HVD_KV_ADDR"],
-                      int(os.environ["HVD_KV_PORT"]),
-                      secret=os.environ.get("HVD_SECRET_KEY"))
-    startup_timeout = float(os.environ.get("HVD_START_TIMEOUT", "600"))
+    rank = int(envs.require(envs.RANK))
+    client = KVClient(envs.require(envs.KV_ADDR),
+                      int(envs.require(envs.KV_PORT)),
+                      secret=envs.get(envs.SECRET_KEY))
+    startup_timeout = envs.get_float(envs.START_TIMEOUT, 600.0)
     fn, args, kwargs = cloudpickle.loads(
         client.wait("exec/fn", timeout=startup_timeout))
     try:

@@ -341,9 +341,9 @@ def _maybe_bootstrap_kv() -> None:
         else:
             payload = client.blocking_key_value_get(key, 60_000)
         addr, port, secret = payload.split(":", 2)
-        os.environ["HVD_KV_ADDR"] = addr
-        os.environ["HVD_KV_PORT"] = port
-        os.environ["HVD_SECRET_KEY"] = secret
+        envs.set_env(envs.KV_ADDR, addr)
+        envs.set_env(envs.KV_PORT, port)
+        envs.set_env(envs.SECRET_KEY, secret)
         _bootstrap_seeded_env = True
         hvd_logging.info("negotiation KV bootstrapped at %s:%s", addr, port)
     except Exception as e:

@@ -43,6 +43,7 @@ from typing import Any, Callable
 from ..runner import hosts as hosts_mod
 from ..runner.http_kv import KVServer, local_addresses, make_secret
 from ..runner.launch import _free_port, worker_env
+from ..utils import envs
 
 DEFAULT_START_TIMEOUT_S = 600.0
 _REGISTER_SCOPE = "spark/registered"
@@ -117,8 +118,8 @@ def run(fn: Callable, args=(), kwargs: dict | None = None,
         num_proc = sc.defaultParallelism
     num_proc = int(num_proc)
     if start_timeout is None:
-        start_timeout = float(os.environ.get("HVD_SPARK_START_TIMEOUT",
-                                             DEFAULT_START_TIMEOUT_S))
+        start_timeout = envs.get_float(envs.SPARK_START_TIMEOUT,
+                                       DEFAULT_START_TIMEOUT_S)
 
     secret = make_secret()
     kv = KVServer(secret=secret)

@@ -46,8 +46,14 @@ PIPELINE_THRESHOLD = "PIPELINE_THRESHOLD"  # bytes; fused wire buffers past this
 PIPELINE_CHUNKS = "PIPELINE_CHUNKS"  # chunk count for the large-buffer software pipeline
 PIPELINE_PINGPONG = "PIPELINE_PINGPONG"  # auto|1|0: recycle wire buffers across flushes via donation
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
+DYNAMIC_ENGINE = "DYNAMIC_ENGINE"  # 0 disables multi-process negotiation
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
 GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
+SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # force sparse grads onto dense allreduce
+FLASH_ATTENTION = "FLASH_ATTENTION"  # opt into the Pallas flash kernel
+DEBUG_INVARIANTS = "DEBUG_INVARIANTS"  # dev-mode runtime invariant checker
+SPARK_START_TIMEOUT = "SPARK_START_TIMEOUT"  # spark barrier-task scheduling bound
+START_TIMEOUT = "START_TIMEOUT"  # programmatic run() worker startup bound
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -66,6 +72,7 @@ KV_PORT = "KV_PORT"
 SECRET_KEY = "SECRET_KEY"
 HOSTNAME = "HOSTNAME"
 ELASTIC = "ELASTIC"  # "1" in workers launched by an elastic driver
+ELASTIC_ROUND = "ELASTIC_ROUND"  # round a worker was spawned into (seeded)
 
 _PREFIXES = ("HVD_", "HOROVOD_")
 
@@ -129,6 +136,31 @@ def get(name: str, default: str | None = None) -> str | None:
     if val is not None:
         return val
     return default
+
+
+def require(name: str) -> str:
+    """Look up knob ``name`` like :func:`get`, but raise when it is absent
+    — for the launcher-seeded worker contract (``HVD_RANK``/``HVD_KV_*``),
+    where a missing variable means the process was not started by a
+    launcher and continuing would only fail more confusingly later."""
+    val = get(name)
+    if val is None:
+        raise RuntimeError(
+            f"required environment variable HVD_{name} is not set (workers "
+            "expect the launcher-seeded rendezvous contract; see "
+            "docs/knobs.md)")
+    return val
+
+
+def set_env(name: str, value, *, only_if_unset: bool = False) -> None:
+    """Seed knob ``name`` into the process environment under the ``HVD_``
+    prefix (the launcher/bootstrap side of the contract). Writing through
+    the registry keeps the knob inventory centralized; ``only_if_unset``
+    preserves an existing HVD_/HOROVOD_ spelling (``setdefault``)."""
+    if only_if_unset and any(
+            os.environ.get(p + name) is not None for p in _PREFIXES):
+        return
+    os.environ["HVD_" + name] = str(value)
 
 
 def get_bool(name: str, default: bool = False) -> bool:

@@ -1,0 +1,483 @@
+"""hvdlint static-analysis suite: fixture-driven per-pass tests + the
+repo-tree gate.
+
+Every pass gets (at least) one fixture that TRIPS the rule and one that
+PASSES it, exercised through the same ``Project``/``run_all`` machinery
+the CLI uses; the final test runs the whole suite over the real
+``horovod_tpu`` tree and requires zero findings — the same gate ci.sh
+enforces.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.hvdlint import Project, run_all  # noqa: E402
+
+ENVS_FIXTURE = 'GOOD_KNOB = "GOOD_KNOB"\n'
+KNOBS_DOC_FIXTURE = "| `HVD_GOOD_KNOB` | documented |\n"
+
+
+def make_project(tmp_path, ops_sources: dict[str, str], *,
+                 envs_py: str = ENVS_FIXTURE,
+                 knobs_md: str = KNOBS_DOC_FIXTURE,
+                 extra: dict[str, str] | None = None) -> Project:
+    pkg = tmp_path / "pkg"
+    (pkg / "ops").mkdir(parents=True)
+    (pkg / "utils").mkdir()
+    (tmp_path / "docs").mkdir()
+    (pkg / "utils" / "envs.py").write_text(envs_py)
+    (tmp_path / "docs" / "knobs.md").write_text(knobs_md)
+    for name, src in ops_sources.items():
+        (pkg / "ops" / name).write_text(textwrap.dedent(src))
+    for rel, src in (extra or {}).items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return Project(tmp_path, package_rel="pkg")
+
+
+def findings_for(tmp_path, pass_name: str, ops_sources: dict[str, str],
+                 **kwargs):
+    project = make_project(tmp_path, ops_sources, **kwargs)
+    return run_all(project, only=[pass_name])
+
+
+# ---------------------------------------------------------------------------
+# issue-lock
+# ---------------------------------------------------------------------------
+
+class TestIssueLock:
+    def test_trips_on_unwrapped_jit(self, tmp_path):
+        src = """
+            import jax
+
+            def build():
+                return jax.jit(jax.shard_map(lambda x: x, mesh=None))
+        """
+        found = findings_for(tmp_path, "issue-lock", {"bad.py": src})
+        assert len(found) == 1
+        assert "issue_serialized" in found[0].message
+        assert found[0].path == "pkg/ops/bad.py"
+
+    def test_trips_on_eager_shard_map_invocation(self, tmp_path):
+        src = """
+            import jax
+
+            def run(x):
+                return jax.shard_map(lambda y: y, mesh=None)(x)
+        """
+        found = findings_for(tmp_path, "issue-lock", {"bad.py": src})
+        assert len(found) == 1
+        assert "shard_map" in found[0].message
+
+    def test_passes_when_wrapped(self, tmp_path):
+        src = """
+            import jax
+            from .program_issue import issue_serialized as _issue_serialized
+
+            def build():
+                return _issue_serialized(
+                    jax.jit(jax.shard_map(lambda x: x, mesh=None)))
+        """
+        found = findings_for(tmp_path, "issue-lock", {"good.py": src})
+        assert found == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        src = """
+            import jax
+
+            def build():
+                return jax.jit(lambda x: x)  # hvdlint: disable=issue-lock
+        """
+        found = findings_for(tmp_path, "issue-lock", {"ok.py": src})
+        assert found == []
+
+    def test_wrapper_in_enclosing_scope_does_not_cover_nested_def(
+            self, tmp_path):
+        src = """
+            import jax
+            from .program_issue import issue_serialized
+
+            def build():
+                return issue_serialized(make())
+
+            def make():
+                def inner():
+                    return jax.jit(lambda x: x)
+                return inner
+        """
+        # the jit inside `inner` is NOT lexically wrapped
+        found = findings_for(tmp_path, "issue-lock", {"bad.py": src})
+        assert len(found) == 1
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_trips_on_nested_with_cycle(self, tmp_path):
+        src = """
+            import threading
+            _a_lock = threading.Lock()
+            _b_lock = threading.Lock()
+
+            def ab():
+                with _a_lock:
+                    with _b_lock:
+                        pass
+
+            def ba():
+                with _b_lock:
+                    with _a_lock:
+                        pass
+        """
+        found = findings_for(tmp_path, "lock-order", {"cycle.py": src})
+        assert len(found) == 1
+        assert "cycle" in found[0].message
+        assert "_a_lock" in found[0].message and "_b_lock" in found[0].message
+
+    def test_trips_on_interprocedural_cycle(self, tmp_path):
+        src = """
+            import threading
+            _a_lock = threading.Lock()
+            _b_lock = threading.Lock()
+
+            def ab():
+                with _a_lock:
+                    with _b_lock:
+                        pass
+
+            def ba():
+                with _b_lock:
+                    helper()
+
+            def helper():
+                with _a_lock:
+                    pass
+        """
+        found = findings_for(tmp_path, "lock-order", {"cycle.py": src})
+        assert len(found) == 1
+        assert "call into helper" in found[0].message
+
+    def test_passes_on_consistent_order(self, tmp_path):
+        src = """
+            import threading
+            _a_lock = threading.Lock()
+            _b_lock = threading.Lock()
+
+            def one():
+                with _a_lock:
+                    with _b_lock:
+                        pass
+
+            def two():
+                with _a_lock:
+                    with _b_lock:
+                        pass
+
+            def sequential():
+                with _b_lock:
+                    pass
+                with _a_lock:
+                    pass
+        """
+        found = findings_for(tmp_path, "lock-order", {"ok.py": src})
+        assert found == []
+
+    def test_nested_def_not_under_enclosing_lock(self, tmp_path):
+        # a closure DEFINED under a lock runs later: no A->B edge
+        src = """
+            import threading
+            _a_lock = threading.Lock()
+            _b_lock = threading.Lock()
+
+            def build():
+                with _a_lock:
+                    def cb():
+                        with _b_lock:
+                            pass
+                return cb
+
+            def other():
+                with _b_lock:
+                    with _a_lock:
+                        pass
+        """
+        found = findings_for(tmp_path, "lock-order", {"ok.py": src})
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# timer-purity
+# ---------------------------------------------------------------------------
+
+TIMER_PRELUDE = "import time\nimport random\n"
+
+
+class TestTimerPurity:
+    def _fixture(self, body: str) -> str:
+        return TIMER_PRELUDE + textwrap.dedent(body)
+
+    def test_trips_on_wallclock_random_and_set_iteration(self, tmp_path):
+        src = self._fixture("""
+            class FusionScheduler:
+                def _loop(self):  # hvdlint: timer-root
+                    t = time.time()
+                    random.random()
+                    for name in {"a", "b"}:
+                        self.flush(name)
+
+                def flush(self, name):
+                    pass
+        """)
+        found = findings_for(tmp_path, "timer-purity", {"sched.py": src})
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 3
+        assert "time.time" in msgs
+        assert "random" in msgs
+        assert "unordered set" in msgs
+
+    def test_trips_on_reachable_negotiation(self, tmp_path):
+        src = self._fixture("""
+            class FusionScheduler:
+                def _loop(self):  # hvdlint: timer-root
+                    self.flush("x")
+
+                def flush(self, key):
+                    self.svc.negotiate_many([])
+        """)
+        found = findings_for(tmp_path, "timer-purity", {"sched.py": src})
+        assert len(found) == 1
+        assert "negotiate" in found[0].message
+
+    def test_monotonic_and_boundary_pass(self, tmp_path):
+        src = self._fixture("""
+            class FusionScheduler:
+                def _loop(self):  # hvdlint: timer-root
+                    now = time.monotonic()
+                    self.flush("x")
+
+                def flush(self, key):
+                    dispatch(key)
+
+            def dispatch(key):  # hvdlint: timer-boundary
+                import time as _t
+                _t.time()  # unreachable for svc queues: boundary stops here
+        """)
+        found = findings_for(tmp_path, "timer-purity", {"sched.py": src})
+        assert found == []
+
+    def test_pragma_suppresses_guarded_call(self, tmp_path):
+        src = self._fixture("""
+            class FusionScheduler:
+                def _loop(self):  # hvdlint: timer-root
+                    self.flush("x")
+
+                def flush(self, key):
+                    self.svc.negotiate_many([])  # hvdlint: disable=timer-purity
+        """)
+        found = findings_for(tmp_path, "timer-purity", {"sched.py": src})
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# knob-registry
+# ---------------------------------------------------------------------------
+
+class TestKnobRegistry:
+    def test_trips_on_direct_environ_read(self, tmp_path):
+        src = """
+            import os
+
+            def read():
+                return os.environ.get("HVD_SOMETHING")
+        """
+        found = findings_for(tmp_path, "knob-registry", {"bad.py": src})
+        assert len(found) == 1
+        assert "bypasses the utils/envs.py registry" in found[0].message
+
+    def test_trips_on_literal_getter_arg(self, tmp_path):
+        src = """
+            from ..utils import envs
+
+            def read():
+                return envs.get_bool("GOOD_KNOB")
+        """
+        found = findings_for(tmp_path, "knob-registry", {"bad.py": src})
+        assert len(found) == 1
+        assert "registry constants" in found[0].message
+
+    def test_trips_on_doc_drift_both_directions(self, tmp_path):
+        found = findings_for(
+            tmp_path, "knob-registry", {"empty.py": ""},
+            envs_py='GOOD_KNOB = "GOOD_KNOB"\nNEW_KNOB = "NEW_KNOB"\n',
+            knobs_md="`HVD_GOOD_KNOB` `HVD_GHOST_KNOB`\n")
+        msgs = "\n".join(f.message for f in found)
+        assert "HVD_NEW_KNOB" in msgs and "undocumented" in msgs
+        assert "HVD_GHOST_KNOB" in msgs and "stale" in msgs
+        assert len(found) == 2
+
+    def test_passes_on_registry_usage_and_env_writes(self, tmp_path):
+        src = """
+            import os
+            from ..utils import envs
+
+            def read():
+                return envs.get_bool(envs.GOOD_KNOB)
+
+            def seed():
+                os.environ["HVD_SEEDED"] = "1"  # launcher writes are legal
+        """
+        found = findings_for(tmp_path, "knob-registry", {"ok.py": src})
+        assert found == []
+
+    def test_trips_on_literal_tunable(self, tmp_path):
+        project = make_project(
+            tmp_path, {"empty.py": ""},
+            extra={"autotune.py": """
+                class Tunable:
+                    def __init__(self, knob, candidates):
+                        pass
+
+                def tunables():
+                    return [Tunable("GOOD_KNOB", [1, 2])]
+            """})
+        found = run_all(project, only=["knob-registry"])
+        assert len(found) == 1
+        assert "Tunable" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+class TestDonation:
+    def test_trips_on_read_after_donating_call(self, tmp_path):
+        src = """
+            import jax
+
+            def run(buf):
+                f = jax.jit(lambda x: x, donate_argnums=(0,))
+                out = f(buf)
+                return buf.sum() + out
+        """
+        found = findings_for(tmp_path, "donation", {"bad.py": src})
+        assert len(found) == 1
+        assert "'buf' was donated" in found[0].message
+
+    def test_trips_through_issue_serialized_wrapper_and_star_args(
+            self, tmp_path):
+        src = """
+            import jax
+            from .program_issue import issue_serialized as _issue_serialized
+
+            def run(bufs):
+                wire_fn = _issue_serialized(
+                    jax.jit(lambda *xs: xs, donate_argnums=(0, 1)))
+                outs = wire_fn(*bufs)
+                return bufs[0], outs
+        """
+        found = findings_for(tmp_path, "donation", {"bad.py": src})
+        assert len(found) == 1
+
+    def test_passes_when_rebound_or_unused(self, tmp_path):
+        src = """
+            import jax
+
+            def rebound(buf):
+                f = jax.jit(lambda x: x, donate_argnums=(0,))
+                buf = f(buf)
+                return buf  # rebinding makes the later read safe
+
+            def composed(a, b):
+                f = jax.jit(lambda x: x, donate_argnums=(0,))
+                g = jax.jit(lambda x: x)
+                return f(g(a)) + b  # only a temporary is donated
+        """
+        found = findings_for(tmp_path, "donation", {"ok.py": src})
+        assert found == []
+
+    def test_closure_violation_reported_exactly_once(self, tmp_path):
+        # the donating binding lives in the builder; the bad read lives in
+        # the nested execute closure — one finding, not two (the outer
+        # sweep must not descend into nested defs)
+        src = """
+            import jax
+
+            def build(bufs):
+                wire_fn = jax.jit(lambda *xs: xs, donate_argnums=(0,))
+
+                def execute():
+                    outs = wire_fn(*bufs)
+                    return bufs, outs
+
+                return execute
+        """
+        found = findings_for(tmp_path, "donation", {"bad.py": src})
+        assert len(found) == 1
+        assert "'bufs' was donated" in found[0].message
+
+    def test_non_donating_positions_are_free(self, tmp_path):
+        src = """
+            import jax
+
+            def run(scratch, data):
+                f = jax.jit(lambda s, d: d, donate_argnums=(0,))
+                out = f(scratch, data)
+                return data.sum() + out  # position 1 is not donated
+        """
+        found = findings_for(tmp_path, "donation", {"ok.py": src})
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# the real tree + CLI contract
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_tree_is_clean(self):
+        project = Project(REPO_ROOT, package_rel="horovod_tpu")
+        found = run_all(project)
+        assert found == [], "\n".join(f.format() for f in found)
+
+    def test_cli_exit_codes(self, tmp_path):
+        clean = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "horovod_tpu"],
+            cwd=REPO_ROOT, capture_output=True, text=True)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        assert "clean" in clean.stdout
+
+        missing = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "no_such_pkg"],
+            cwd=REPO_ROOT, capture_output=True, text=True)
+        assert missing.returncode == 2
+
+    def test_cli_nonzero_on_findings(self, tmp_path):
+        make_project(tmp_path, {"bad.py": """
+            import os
+
+            def read():
+                return os.environ.get("HVD_X")
+        """})
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "pkg"],
+            cwd=tmp_path, env={"PYTHONPATH": str(REPO_ROOT),
+                               "PATH": "/usr/bin:/bin"},
+            capture_output=True, text=True)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "[knob-registry]" in proc.stdout
+
+    def test_every_pass_registered(self):
+        from tools.hvdlint import PASSES
+        assert list(PASSES) == ["issue-lock", "lock-order", "timer-purity",
+                                "knob-registry", "donation"]

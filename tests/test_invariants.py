@@ -1,0 +1,317 @@
+"""HVD_DEBUG_INVARIANTS runtime checker: lock-order witness,
+thread-affinity assertions, re-entrancy guard — plus the fusion-scheduler
+integration (the checker wired into ``ops/fusion_cycle.py``)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from horovod_tpu.utils import invariants as inv  # noqa: E402
+
+
+@pytest.fixture
+def debug_invariants():
+    """Enable the checker for one test, then restore the process's prior
+    state exactly (CI runs this file with HVD_DEBUG_INVARIANTS=1 exported
+    globally — force-deleting it would silently disable the checker for
+    the stress suites that run after)."""
+    prior = os.environ.get("HVD_DEBUG_INVARIANTS")
+    os.environ["HVD_DEBUG_INVARIANTS"] = "1"
+    inv.refresh()
+    inv.reset()
+    yield inv
+    if prior is None:
+        os.environ.pop("HVD_DEBUG_INVARIANTS", None)
+    else:
+        os.environ["HVD_DEBUG_INVARIANTS"] = prior
+    inv.refresh()
+    inv.reset()
+
+
+@pytest.fixture
+def checker_disabled():
+    """Force the cached enabled flag off without touching the
+    environment (the flag is what every assert site reads)."""
+    old = inv._ENABLED
+    inv._ENABLED = False
+    yield inv
+    inv._ENABLED = old
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness
+# ---------------------------------------------------------------------------
+
+class TestLockOrderWitness:
+    def test_inversion_raises_with_both_stacks(self, debug_invariants):
+        a = inv.make_lock("test.a")
+        b = inv.make_lock("test.b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(inv.InvariantViolation) as exc:
+            with b:
+                with a:
+                    pass
+        msg = str(exc.value)
+        assert "lock-order" in msg
+        assert "earlier acquisition" in msg
+        assert "current acquisition" in msg
+        assert "test.a" in msg and "test.b" in msg
+        assert inv.report()["counts"]["lock-order"] == 1
+
+    def test_inversion_detected_across_threads(self, debug_invariants):
+        a = inv.make_lock("test.a")
+        b = inv.make_lock("test.b")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=t1)
+        t.start()
+        t.join()
+        with pytest.raises(inv.InvariantViolation):
+            with b:
+                with a:
+                    pass
+
+    def test_violation_raised_before_blocking(self, debug_invariants):
+        # the witness must report the potential deadlock, not exhibit it:
+        # the inversion raises even while the other thread HOLDS the lock
+        a = inv.make_lock("test.a")
+        b = inv.make_lock("test.b")
+        with a:
+            with b:
+                pass
+        a.acquire()  # now b -> a would block forever without the witness
+        try:
+            with pytest.raises(inv.InvariantViolation):
+                with b:
+                    with a:
+                        pass
+        finally:
+            a.release()
+
+    def test_transitive_cycle_detected(self, debug_invariants):
+        # A -> B and B -> C recorded; C -> A closes a 3-cycle that no
+        # pairwise check would see.
+        a = inv.make_lock("test.a")
+        b = inv.make_lock("test.b")
+        c = inv.make_lock("test.c")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(inv.InvariantViolation) as exc:
+            with c:
+                with a:
+                    pass
+        assert "test.a -> test.b -> test.c" in str(exc.value)
+        assert inv.report()["counts"]["lock-order"] == 1
+
+    def test_consistent_order_is_clean(self, debug_invariants):
+        a = inv.make_lock("test.a")
+        b = inv.make_lock("test.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        with b:  # sequential, not nested: no edge
+            pass
+        with a:
+            pass
+        assert inv.report()["counts"]["lock-order"] == 0
+
+    def test_rlock_reentrancy_is_not_an_edge(self, debug_invariants):
+        r = inv.make_rlock("test.r")
+        with r:
+            with r:
+                pass
+        assert inv.report()["counts"]["lock-order"] == 0
+
+    def test_condition_wait_keeps_held_state(self, debug_invariants):
+        cv = inv.make_condition("test.cv")
+        outer = inv.make_lock("test.outer")
+        done = []
+
+        def consumer():
+            with cv:
+                while not done:
+                    cv.wait(0.05)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        with outer:
+            with cv:
+                done.append(1)
+                cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert inv.held_locks() == ()
+        # outer -> cv was recorded; cv -> outer must now raise
+        with pytest.raises(inv.InvariantViolation):
+            with cv:
+                with outer:
+                    pass
+
+    def test_disabled_returns_plain_primitives(self, checker_disabled):
+        assert not inv.enabled()
+        assert isinstance(inv.make_lock("x"), type(threading.Lock()))
+        assert inv.make_condition("x").__class__ is threading.Condition
+
+
+# ---------------------------------------------------------------------------
+# thread-affinity + holding assertions
+# ---------------------------------------------------------------------------
+
+class TestAffinityAssertions:
+    def test_assert_holding_passes_under_lock(self, debug_invariants):
+        mu = inv.make_lock("test.mu")
+        with mu:
+            inv.assert_holding(mu, "guarded mutation")
+
+    def test_assert_holding_raises_without_lock(self, debug_invariants):
+        mu = inv.make_lock("test.mu")
+        with pytest.raises(inv.InvariantViolation) as exc:
+            inv.assert_holding(mu, "guarded mutation")
+        assert "guarded mutation" in str(exc.value)
+        assert inv.report()["counts"]["lock-held"] == 1
+
+    def test_assert_thread(self, debug_invariants):
+        other = threading.Thread(target=lambda: None)
+        inv.assert_thread(None, "no owner yet")  # no-op
+        inv.assert_thread(threading.current_thread(), "self is fine")
+        with pytest.raises(inv.InvariantViolation):
+            inv.assert_thread(other, "executor-private state")
+        assert inv.report()["counts"]["thread-affinity"] == 1
+
+    def test_counters_without_raise(self, debug_invariants):
+        inv.raise_on_violation = False
+        try:
+            mu = inv.make_lock("test.mu")
+            inv.assert_holding(mu, "mutation")
+            inv.assert_holding(mu, "mutation")
+        finally:
+            inv.raise_on_violation = True
+        rep = inv.report()
+        assert rep["counts"]["lock-held"] == 2
+        assert len(rep["violations"]) == 2
+
+    def test_disabled_asserts_are_noops(self, checker_disabled):
+        mu = inv.make_lock("test.mu")
+        inv.assert_holding(mu, "whatever")
+        inv.assert_thread(threading.Thread(target=lambda: None), "whatever")
+        inv.assert_outside("nowhere", "whatever")
+
+
+# ---------------------------------------------------------------------------
+# re-entrancy guard
+# ---------------------------------------------------------------------------
+
+class TestReentrancyGuard:
+    def test_assert_outside_raises_inside_section(self, debug_invariants):
+        with inv.section("flush"):
+            with pytest.raises(inv.InvariantViolation):
+                inv.assert_outside("flush", "enqueue during flush")
+        inv.assert_outside("flush", "after exit is fine")
+        assert inv.report()["counts"]["reentrancy"] == 1
+
+    def test_issue_lock_held_tracks_wrapped_calls(self, debug_invariants):
+        from horovod_tpu.ops import program_issue
+        probe = []
+        wrapped = program_issue.issue_serialized(
+            lambda: probe.append(program_issue.issue_lock_held()))
+        assert not program_issue.issue_lock_held()
+        wrapped()
+        assert probe == [True]
+        assert not program_issue.issue_lock_held()
+
+    def test_sections_are_per_thread(self, debug_invariants):
+        errors = []
+
+        def other():
+            try:
+                inv.assert_outside("flush", "other thread")
+            except inv.InvariantViolation as e:  # pragma: no cover
+                errors.append(e)
+
+        with inv.section("flush"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# fusion-scheduler integration (the wired-in checks)
+# ---------------------------------------------------------------------------
+
+class TestSchedulerIntegration:
+    def _scheduler(self, monkeypatch):
+        from horovod_tpu.ops import fusion_cycle
+        # synchronous executor: flushes run inline on the flushing thread
+        monkeypatch.setenv("HVD_MAX_INFLIGHT_FLUSHES", "0")
+        return fusion_cycle.FusionScheduler()
+
+    def _opaque_entry(self, fusion_cycle, run, name="inv-test"):
+        return fusion_cycle._Entry([None], False, 0, [name], run=run)
+
+    def test_scheduler_locks_are_tracked(self, debug_invariants,
+                                         monkeypatch):
+        sched = self._scheduler(monkeypatch)
+        assert getattr(sched._mu, "name", None) == \
+            "fusion_cycle.scheduler.mu"
+
+    def test_opaque_flush_executes_cleanly(self, debug_invariants,
+                                           monkeypatch):
+        from horovod_tpu.ops import fusion_cycle
+        sched = self._scheduler(monkeypatch)
+        spec = fusion_cycle._QueueSpec("sparse", None, None, svc=None)
+        entry = self._opaque_entry(fusion_cycle, lambda: 42)
+        sched.enqueue(("sparse", "k"), spec, entry)
+        sched.flush_queue(("sparse", "k"), "synchronize")
+        assert entry.done and entry.error is None
+        assert entry.results == [42]
+        assert inv.report()["violations"] == []
+        sched.stop()
+
+    def test_reentrant_enqueue_from_flush_is_caught(self, debug_invariants,
+                                                    monkeypatch):
+        from horovod_tpu.ops import fusion_cycle
+        sched = self._scheduler(monkeypatch)
+        spec = fusion_cycle._QueueSpec("sparse", None, None, svc=None)
+
+        def reenter():
+            inner = self._opaque_entry(fusion_cycle, lambda: 0, "inner")
+            sched.enqueue(("sparse", "k2"), spec, inner)
+
+        entry = self._opaque_entry(fusion_cycle, reenter, "outer")
+        sched.enqueue(("sparse", "k1"), spec, entry)
+        sched.flush_queue(("sparse", "k1"), "synchronize")
+        assert entry.done
+        assert isinstance(entry.error, inv.InvariantViolation)
+        assert inv.report()["counts"]["reentrancy"] == 1
+        sched.stop()
+
+    def test_admit_slot_off_executor_thread_is_caught(self, debug_invariants,
+                                                      monkeypatch):
+        sched = self._scheduler(monkeypatch)
+        # simulate a live executor owned by another thread
+        sched._exec_thread = threading.Thread(target=lambda: None,
+                                              name="fake-executor")
+        with pytest.raises(inv.InvariantViolation):
+            sched._admit_slot()
+        assert inv.report()["counts"]["thread-affinity"] == 1
+        sched._exec_thread = None
+        sched.stop()

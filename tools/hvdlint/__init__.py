@@ -1,0 +1,56 @@
+"""hvdlint: project-invariant static analysis for the horovod_tpu runtime.
+
+Five AST passes, each encoding a concurrency/determinism invariant that a
+PR introduced and a future regression would break silently (a hang or a
+cross-rank divergence, not a test failure):
+
+=============  ==============================================================
+pass           invariant (provenance)
+=============  ==============================================================
+issue-lock     compiled eager collectives enqueue under the program-issue
+               lock (PR 3's reproduced XLA rendezvous deadlock)
+lock-order     the static ``with``-nesting graph across modules is acyclic
+               (the documented one-way ``_mu -> _exec_cv`` convention)
+timer-purity   nothing reachable from the cycle timer reads wall clocks,
+               randomness, negotiates, or iterates sets into batch order
+               (PR 2-3's rank-deterministic flush composition contract)
+knob-registry  every HVD_* knob flows through utils/envs.py and round-trips
+               with docs/knobs.md + the autotune tunables (PR 1's
+               override-epoch invalidation)
+donation       a donated buffer is never referenced after the donating call
+               (PR 1's aliasing rules; CPU tests cannot catch this)
+=============  ==============================================================
+
+Run ``python -m tools.hvdlint horovod_tpu`` from the repo root; findings
+print as ``file:line: [pass] message`` and a nonzero exit fails CI.
+Suppress a vetted exception inline with ``# hvdlint: disable=<pass>``.
+Full catalog: docs/static_analysis.md. The dynamic counterpart is the
+``HVD_DEBUG_INVARIANTS=1`` runtime checker
+(``horovod_tpu/utils/invariants.py``).
+"""
+
+from __future__ import annotations
+
+from .core import Finding, Project
+from .passes import PASSES
+
+__all__ = ["Finding", "PASSES", "Project", "run_all"]
+
+
+def run_all(project: Project, only: list[str] | None = None
+            ) -> list[Finding]:
+    """Run the suite (or the ``only`` subset) and return deduplicated
+    findings in (path, line) order."""
+    names = list(PASSES) if not only else only
+    out: list[Finding] = []
+    seen: set[Finding] = set()
+    for name in names:
+        if name not in PASSES:
+            raise KeyError(f"unknown hvdlint pass {name!r}; "
+                           f"available: {', '.join(PASSES)}")
+        for f in PASSES[name](project):
+            if f not in seen:
+                seen.add(f)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    return out

@@ -1,0 +1,66 @@
+"""CLI: ``python -m tools.hvdlint <package-dir> [--pass NAME]... [--list]``.
+
+Exit status: 0 = clean, 1 = findings, 2 = usage error. The package
+argument is the path to the analyzed package relative to the repo root
+(normally ``horovod_tpu``); docs are resolved as ``docs/knobs.md``
+next to it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import PASSES, Project, run_all
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvdlint",
+        description="project-invariant static analysis for horovod_tpu "
+                    "(docs/static_analysis.md)")
+    parser.add_argument("package", nargs="?", default="horovod_tpu",
+                        help="package directory to analyze "
+                             "(default: horovod_tpu)")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        metavar="NAME",
+                        help="run only this pass (repeatable); "
+                             "default: all")
+    parser.add_argument("--list", action="store_true",
+                        help="list available passes and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name, fn in PASSES.items():
+            first = (fn.__module__ and
+                     sys.modules[fn.__module__].__doc__ or "").strip()
+            print(f"{name}: {first.splitlines()[0] if first else ''}")
+        return 0
+
+    pkg = Path(args.package)
+    root = pkg.parent if pkg.parent != Path("") else Path(".")
+    if not (root / pkg.name).is_dir():
+        print(f"hvdlint: package directory {args.package!r} not found",
+              file=sys.stderr)
+        return 2
+    project = Project(root, package_rel=pkg.name)
+    try:
+        findings = run_all(project, args.passes)
+    except KeyError as e:
+        print(f"hvdlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+    n_files = len(project.files)
+    if findings:
+        print(f"hvdlint: {len(findings)} finding(s) across {n_files} "
+              "file(s)", file=sys.stderr)
+        return 1
+    ran = ", ".join(args.passes) if args.passes else ", ".join(PASSES)
+    print(f"hvdlint: clean ({n_files} files; passes: {ran})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""hvdlint core: project model, pragma handling, call-graph machinery.
+
+The passes (``tools/hvdlint/passes/``) are AST analyses over a
+:class:`Project` — the ``horovod_tpu`` package plus the repo docs. This
+module owns everything they share:
+
+* :class:`SourceFile` — parsed module + the inline pragma index
+  (``# hvdlint: disable=<pass>[,<pass>]`` suppresses findings anchored on
+  that line — or on the next line when the pragma sits on a comment-only
+  line; ``# hvdlint: <marker>`` attaches a named marker, e.g.
+  ``timer-boundary``, that passes can query).
+* :class:`Project` — the file set, path helpers, and the cross-module
+  function index (:class:`FuncInfo`) with import-aware call resolution:
+  bare names resolve within the module, ``self.method`` within the
+  enclosing class, ``alias.func`` through the module's (or function's)
+  relative imports. Unresolvable calls (methods on runtime objects,
+  stdlib) resolve to ``None`` — the analyses are deliberately
+  conservative about what they claim to know.
+* :func:`dotted_name` / :func:`parent_map` — small AST helpers.
+
+Everything is stdlib-only (``ast``): the suite must run in CI before any
+heavyweight import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+PRAGMA_RE = re.compile(r"#\s*hvdlint:\s*([A-Za-z0-9=,_*-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored at ``path:line``."""
+
+    pass_name: str
+    path: str  # project-root-relative
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+class SourceFile:
+    def __init__(self, root: Path, rel: str):
+        self.rel = rel
+        self.path = root / rel
+        self.text = self.path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(self.path))
+        # line -> set of pass names (or "*") suppressed on that line
+        self.suppressions: dict[int, set[str]] = {}
+        # marker name -> set of line numbers carrying it
+        self.markers: dict[str, set[int]] = {}
+        self._index_pragmas()
+
+    def _index_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if not m:
+                continue
+            body = m.group(1)
+            targets = [i]
+            if line.strip().startswith("#"):
+                targets.append(i + 1)  # comment-only pragma covers the
+                # next line too
+            if body.startswith("disable="):
+                names = {p.strip() for p in body[len("disable="):].split(",")
+                         if p.strip()}
+                for t in targets:
+                    self.suppressions.setdefault(t, set()).update(names)
+            else:
+                for t in targets:
+                    self.markers.setdefault(body.strip(), set()).update([t])
+
+    def suppressed(self, pass_name: str, line: int) -> bool:
+        names = self.suppressions.get(line)
+        return bool(names) and (pass_name in names or "*" in names)
+
+    def has_marker(self, marker: str, line: int) -> bool:
+        """Marker on ``line`` or within the two preceding lines (so a
+        marker comment above a ``def`` also covers it)."""
+        lines = self.markers.get(marker)
+        if not lines:
+            return False
+        return any(ln in lines for ln in range(line - 2, line + 1))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method in the project index."""
+
+    file: "SourceFile"
+    node: ast.FunctionDef
+    qualname: str  # e.g. "FusionScheduler._loop" or "flush_all"
+    class_name: str | None
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.file.rel, self.qualname)
+
+
+class Project:
+    """The analyzed tree: package sources + docs, with a function index
+    and import-aware call resolution."""
+
+    def __init__(self, root, package_rel: str = "horovod_tpu",
+                 knobs_doc_rel: str = "docs/knobs.md"):
+        self.root = Path(root)
+        self.package_rel = package_rel.rstrip("/")
+        self.knobs_doc_rel = knobs_doc_rel
+        self.files: list[SourceFile] = []
+        self.by_rel: dict[str, SourceFile] = {}
+        pkg = self.root / self.package_rel
+        for path in sorted(pkg.rglob("*.py")):
+            rel = path.relative_to(self.root).as_posix()
+            if "__pycache__" in rel:
+                continue
+            sf = SourceFile(self.root, rel)
+            self.files.append(sf)
+            self.by_rel[rel] = sf
+        self._funcs: dict[tuple[str, str], FuncInfo] = {}
+        self._by_name: dict[str, dict[str, list[FuncInfo]]] = {}
+        self._index_functions()
+        self._imports: dict[str, dict[str, str]] = {
+            f.rel: self._module_imports(f) for f in self.files}
+
+    # -- file helpers ------------------------------------------------------
+
+    def package_file(self, tail: str) -> SourceFile | None:
+        return self.by_rel.get(f"{self.package_rel}/{tail}")
+
+    def ops_files(self) -> list[SourceFile]:
+        prefix = f"{self.package_rel}/ops/"
+        return [f for f in self.files if f.rel.startswith(prefix)]
+
+    def knobs_doc_path(self) -> Path:
+        return self.root / self.knobs_doc_rel
+
+    # -- function index ----------------------------------------------------
+
+    def _index_functions(self) -> None:
+        for sf in self.files:
+            for node in sf.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_func(sf, node, node.name, None)
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._add_func(sf, sub,
+                                           f"{node.name}.{sub.name}",
+                                           node.name)
+
+    def _add_func(self, sf, node, qualname, class_name) -> None:
+        info = FuncInfo(sf, node, qualname, class_name)
+        self._funcs[info.key] = info
+        self._by_name.setdefault(sf.rel, {}).setdefault(
+            node.name, []).append(info)
+
+    def func(self, rel: str, qualname: str) -> FuncInfo | None:
+        return self._funcs.get((rel, qualname))
+
+    def functions(self):
+        return self._funcs.values()
+
+    # -- import resolution -------------------------------------------------
+
+    def _resolve_relative(self, rel: str, level: int, module: str | None,
+                          leaf: str) -> str | None:
+        """Map ``from <dots><module> import <leaf>`` in file ``rel`` to a
+        project-relative module path, or None for out-of-project."""
+        parts = rel.split("/")[:-1]  # package dirs of the importing file
+        if level > 0:
+            if level - 1 > len(parts):
+                return None
+            base = parts[:len(parts) - (level - 1)]
+        else:
+            base = []
+        target = base + (module.split(".") if module else []) + [leaf]
+        cand = "/".join(target) + ".py"
+        if cand in self.by_rel:
+            return cand
+        cand = "/".join(target) + "/__init__.py"
+        return cand if cand in self.by_rel else None
+
+    def _collect_imports(self, rel: str, body) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in body:
+            if isinstance(node, ast.ImportFrom):
+                for n in node.names:
+                    target = self._resolve_relative(
+                        rel, node.level, node.module, n.name)
+                    if target is not None:
+                        aliases[n.asname or n.name] = target
+        return aliases
+
+    def _module_imports(self, sf: SourceFile) -> dict[str, str]:
+        return self._collect_imports(sf.rel, sf.tree.body)
+
+    def func_imports(self, info: FuncInfo) -> dict[str, str]:
+        """Module-level imports overlaid with the function's own
+        (function-level imports are the project idiom for cycle-prone
+        modules, e.g. ``from . import collectives as _coll``)."""
+        aliases = dict(self._imports[info.file.rel])
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.ImportFrom):
+                for n in node.names:
+                    target = self._resolve_relative(
+                        info.file.rel, node.level, node.module, n.name)
+                    if target is not None:
+                        aliases[n.asname or n.name] = target
+        return aliases
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, info: FuncInfo, call: ast.Call,
+                     aliases: dict[str, str] | None = None
+                     ) -> FuncInfo | None:
+        """Resolve a call inside ``info`` to a project function:
+        ``name()`` -> same module; ``self.m()`` -> method of the enclosing
+        class (else the module's only class defining ``m``);
+        ``alias.f()`` -> imported module's ``f``. None when unknown."""
+        if aliases is None:
+            aliases = self.func_imports(info)
+        func = call.func
+        if isinstance(func, ast.Name):
+            cands = self._by_name.get(info.file.rel, {}).get(func.id, [])
+            for c in cands:
+                if c.class_name is None:
+                    return c
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            if info.class_name is not None:
+                hit = self.func(info.file.rel,
+                                f"{info.class_name}.{func.attr}")
+                if hit is not None:
+                    return hit
+            cands = [c for c in self._by_name.get(info.file.rel, {})
+                     .get(func.attr, []) if c.class_name is not None]
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(base, ast.Name) and base.id in aliases:
+            target = aliases[base.id]
+            cands = self._by_name.get(target, {}).get(func.attr, [])
+            for c in cands:
+                if c.class_name is None:
+                    return c
+        return None

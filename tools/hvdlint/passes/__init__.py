@@ -1,0 +1,15 @@
+"""hvdlint pass registry (see docs/static_analysis.md for the catalog)."""
+
+from __future__ import annotations
+
+from . import donation, issue_lock, knob_registry, lock_order, timer_purity
+
+# name -> run(project) -> list[Finding]; keep the catalog order stable so
+# output and docs line up.
+PASSES = {
+    issue_lock.NAME: issue_lock.run,
+    lock_order.NAME: lock_order.run,
+    timer_purity.NAME: timer_purity.run,
+    knob_registry.NAME: knob_registry.run,
+    donation.NAME: donation.run,
+}

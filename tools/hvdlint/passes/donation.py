@@ -1,0 +1,307 @@
+"""donation pass: a donated buffer must not be referenced after the
+donating call.
+
+Invariant (PR 1/PR 3, docs/dispatch_cache.md + docs/pipeline.md): the
+dispatch plans donate wire buffers into their compiled programs
+(``donate_argnums``) so the collective reuses their HBM; ping-pong
+chunk plans additionally donate scratch sets. On backends with real
+donation, reading a donated array after the call returns garbage (JAX
+raises only under ``jax_debug_nans``-style checks, and the CPU backend
+silently ignores donation — so a TPU-only corruption can pass every CPU
+test). This pass flags any local name passed in a donated argument
+position and then read later in the same function without rebinding.
+
+What counts as a donating callable:
+
+* a direct ``jax.jit(..., donate_argnums=...)`` result — including one
+  wrapped in ``issue_serialized(...)`` — bound to a local name or called
+  immediately (donated positions parsed from a literal tuple; a dynamic
+  expression conservatively donates every position);
+* results of the project's donating-program constructors, tracked
+  through tuple unpacking: ``_plan_fused_programs`` (wire stage donates
+  all args), ``_plan_chunked_programs`` (fuse stage donates arg 0 under
+  ping-pong; the per-piece programs donate arg 0), and the
+  ``donate=``-parameterized cached constructors
+  (``_eager_grouped_allreduce_fn`` / ``_eager_grouped_broadcast_fn`` /
+  ``_eager_hier_grouped_allreduce_fn`` / ``_piece_allreduce_fn``).
+
+Bindings flow into nested functions (the plan ``execute`` closures are
+where the calls actually happen). The analysis is line-ordered (control
+flow is ignored), so a vetted re-use in a loop can be suppressed with
+``# hvdlint: disable=donation``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, dotted_name
+
+NAME = "donation"
+
+ALL = "ALL"
+
+_WRAPPERS = ("issue_serialized", "_issue_serialized", "functools.lru_cache")
+
+# constructor name -> donation spec of its result(s):
+#   a spec is ALL, a frozenset of positions, None (never donates), or
+#   "donate-kwarg" (positions derive from the call's donate= argument);
+#   a tuple of specs describes tuple-unpacked results; ("list", spec)
+#   marks a list of callables each with `spec`.
+CONSTRUCTORS = {
+    "_plan_fused_programs": (None, ALL),
+    "_plan_chunked_programs": (frozenset({0}), ("list", frozenset({0})),
+                               None, None),
+    "_eager_grouped_allreduce_fn": "donate-kwarg",
+    "_eager_grouped_broadcast_fn": "donate-kwarg",
+    "_eager_hier_grouped_allreduce_fn": "donate-kwarg",
+    "_piece_allreduce_fn": "donate-kwarg",
+}
+
+
+def _unwrap(call: ast.Call) -> ast.Call:
+    """Peel issue_serialized(...) wrappers off a constructor expression."""
+    while True:
+        name = dotted_name(call.func)
+        if (name is not None and name.split(".")[-1] in
+                [w.split(".")[-1] for w in _WRAPPERS]
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Call)):
+            call = call.args[0]
+            continue
+        return call
+
+
+def _jit_donated_positions(call: ast.Call):
+    """Donated positions of a ``jax.jit(...)`` call, or None when it does
+    not donate."""
+    if dotted_name(call.func) not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        val = kw.value
+        if isinstance(val, ast.Tuple) and all(
+                isinstance(e, ast.Constant) for e in val.elts):
+            pos = frozenset(e.value for e in val.elts)
+            return pos or None
+        if isinstance(val, ast.Constant):
+            return frozenset({val.value}) if val.value != () else None
+        return ALL  # dynamic mask: assume every position may donate
+    return None
+
+
+def _donate_kwarg_positions(call: ast.Call):
+    """Donation spec from a constructor's ``donate=`` argument."""
+    for kw in call.keywords:
+        if kw.arg != "donate":
+            continue
+        val = kw.value
+        if isinstance(val, ast.Constant):
+            if val.value in (False, None, ()):
+                return None
+            return frozenset({0})  # donate=True: single-buffer programs
+        return ALL
+    return None
+
+
+def _spec_of_value(expr: ast.AST):
+    """Donation spec for the value of an assignment, or None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    call = _unwrap(expr)
+    jit_pos = _jit_donated_positions(call)
+    if jit_pos is not None:
+        return jit_pos
+    name = dotted_name(call.func)
+    if name is not None:
+        spec = CONSTRUCTORS.get(name.split(".")[-1])
+        if spec == "donate-kwarg":
+            return _donate_kwarg_positions(call)
+        if spec is not None:
+            return spec
+    return None
+
+
+def _consumed_args(call: ast.Call, spec) -> list[tuple[str, int]]:
+    """(name, lineno) of local names passed in donated positions."""
+    out = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            # positions >= i are covered by the star; conservatively
+            # consumed whenever any donated position can land there
+            if spec is ALL or (isinstance(spec, frozenset)
+                               and any(p >= i for p in spec)):
+                if isinstance(arg.value, ast.Name):
+                    out.append((arg.value.id, call.lineno))
+            continue
+        if spec is ALL or (isinstance(spec, frozenset) and i in spec):
+            if isinstance(arg, ast.Name):
+                out.append((arg.id, call.lineno))
+    return out
+
+
+def _walk_local(fn: ast.FunctionDef):
+    """Walk ``fn``'s body excluding nested function subtrees — those are
+    analyzed separately (with the inherited binding env) by
+    ``_recurse_nested``; visiting them here too would double-report
+    findings and mix loads across sibling closures."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _binding_lines(fn: ast.FunctionDef) -> dict[str, list[int]]:
+    """name -> lines where the name is (re)bound."""
+    lines: dict[str, list[int]] = {}
+
+    def bind(target: ast.AST, lineno: int) -> None:
+        if isinstance(target, ast.Name):
+            lines.setdefault(target.id, []).append(lineno)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                bind(e, lineno)
+        elif isinstance(target, ast.Starred):
+            bind(target.value, lineno)
+
+    for node in _walk_local(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind(t, node.lineno)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            bind(node.target, node.lineno)
+        elif isinstance(node, ast.For):
+            bind(node.target, node.lineno)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    bind(item.optional_vars, node.lineno)
+    return lines
+
+
+def _analyze_function(sf, fn: ast.FunctionDef, inherited: dict,
+                      findings: list[Finding]) -> None:
+    env = dict(inherited)
+
+    # 1st sweep: collect donating bindings (tuple unpacking included)
+    for node in _walk_local(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            spec = _spec_of_value(node.value)
+            target = node.targets[0]
+            if spec is None:
+                # rebinding a name clears any stale donating spec
+                if isinstance(target, ast.Name):
+                    env.pop(target.id, None)
+                continue
+            if isinstance(target, ast.Name):
+                env[target.id] = spec
+            elif (isinstance(target, ast.Tuple)
+                  and isinstance(spec, tuple)):
+                for elt, sub in zip(target.elts, spec):
+                    if not isinstance(elt, ast.Name) or sub is None:
+                        continue
+                    if isinstance(sub, tuple) and sub[0] == "list":
+                        env[elt.id] = sub  # a list of donating callables
+                    else:
+                        env[elt.id] = sub
+        elif isinstance(node, ast.For):
+            # `for piece, f in zip(xs, piece_fns):` — loop names drawn
+            # from a list-of-donating-callables donate like its elements
+            env.update(_loop_bindings(node, env))
+
+    # 2nd sweep: find donated names read after the donating call
+    bindings = _binding_lines(fn)
+    loads: dict[str, list[int]] = {}
+    for node in _walk_local(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.setdefault(node.id, []).append(node.lineno)
+
+    for node in _walk_local(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        spec = None
+        if isinstance(node.func, ast.Name):
+            spec = env.get(node.func.id)
+            if isinstance(spec, tuple) and spec and spec[0] == "list":
+                spec = None  # the list itself is not callable
+        else:
+            direct = _spec_of_value(node.func) if isinstance(
+                node.func, ast.Call) else None
+            spec = direct
+        if spec is None:
+            continue
+        for name, call_line in _consumed_args(node, spec):
+            # a rebind on the call line itself is `x = f(x)` — the
+            # assignment lands after the donation, so later reads see
+            # the fresh binding
+            rebinds = [ln for ln in bindings.get(name, ())
+                       if ln >= call_line]
+            horizon = min(rebinds) if rebinds else None
+            for load_line in loads.get(name, ()):
+                if load_line <= call_line:
+                    continue
+                if horizon is not None and load_line >= horizon:
+                    continue
+                if sf.suppressed(NAME, load_line):
+                    continue
+                findings.append(Finding(
+                    NAME, sf.rel, load_line,
+                    f"{name!r} was donated at line {call_line} "
+                    "(its buffer may be reused by the compiled program) "
+                    "but is referenced afterwards — reading a donated "
+                    "array is undefined on backends with real donation"))
+                break  # one finding per consumed name is enough
+
+    # nested functions inherit the enclosing donating bindings (plan
+    # execute closures call programs constructed in the builder)
+    for node in ast.iter_child_nodes(fn):
+        _recurse_nested(sf, node, env, findings)
+
+
+def _loop_bindings(node: ast.For, env: dict) -> dict:
+    out: dict = {}
+    it = node.iter
+    sources: list[ast.AST] = []
+    if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "zip"):
+        sources = list(it.args)
+    else:
+        sources = [it]
+    targets = (list(node.target.elts)
+               if isinstance(node.target, ast.Tuple) else [node.target])
+    if len(targets) != len(sources):
+        return out
+    for tgt, src in zip(targets, sources):
+        if not (isinstance(tgt, ast.Name) and isinstance(src, ast.Name)):
+            continue
+        spec = env.get(src.id)
+        if isinstance(spec, tuple) and spec and spec[0] == "list":
+            out[tgt.id] = spec[1]
+    return out
+
+
+def _recurse_nested(sf, node: ast.AST, env: dict,
+                    findings: list[Finding]) -> None:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _analyze_function(sf, node, env, findings)
+        return
+    for child in ast.iter_child_nodes(node):
+        _recurse_nested(sf, child, env, findings)
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.ops_files():
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _analyze_function(sf, node, {}, findings)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        _analyze_function(sf, sub, {}, findings)
+    return findings

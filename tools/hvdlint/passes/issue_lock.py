@@ -1,0 +1,89 @@
+"""issue-lock pass: compiled-collective programs must enqueue under the
+process-wide program-issue lock.
+
+Invariant (PR 3, ``ops/program_issue.py``): two threads interleaving the
+per-device enqueues of two multi-device collective programs deadlock the
+backend's collective rendezvous — reproduced on the XLA CPU backend. The
+fix is that every *eager compiled program constructor* in the ops layer
+wraps its ``jax.jit(...)`` in ``issue_serialized`` so concurrent callers
+enqueue atomically. This pass makes the wrapper a machine-checked rule:
+
+* every ``jax.jit(...)`` call in ``horovod_tpu/ops/`` must appear inside
+  an ``issue_serialized(...)`` / ``_issue_serialized(...)`` call;
+* an eagerly-invoked ``jax.shard_map(...)(x)`` (compiled multi-device
+  program executed without jit, hence without the lock) is flagged too.
+
+``ops/program_issue.py`` itself is exempt (it defines the wrapper).
+Traced-mode code outside ``ops/`` composes into the *user's* jit and
+never dispatches eagerly, so the rule is scoped to the eager dispatch
+layer. Suppress a deliberate exception with
+``# hvdlint: disable=issue-lock``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, dotted_name, parent_map
+
+NAME = "issue-lock"
+
+WRAPPERS = ("issue_serialized", "_issue_serialized")
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name in ("jax.jit", "jit")
+
+
+def _is_shard_map(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name in ("jax.shard_map", "shard_map",
+                    "jax.experimental.shard_map.shard_map")
+
+
+def _wrapped(call: ast.Call, parents) -> bool:
+    node = parents.get(call)
+    while node is not None:
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None and name.split(".")[-1] in WRAPPERS:
+                return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # don't escape the defining scope: a wrapper call in an
+            # enclosing function does not cover a jit built inside a
+            # nested one
+            return False
+        node = parents.get(node)
+    return False
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.ops_files():
+        if sf.rel.endswith("/program_issue.py"):
+            continue
+        parents = parent_map(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jax_jit(node) and not _wrapped(node, parents):
+                if not sf.suppressed(NAME, node.lineno):
+                    findings.append(Finding(
+                        NAME, sf.rel, node.lineno,
+                        "jax.jit(...) outside issue_serialized(...): "
+                        "compiled eager programs must enqueue under the "
+                        "program-issue lock (ops/program_issue.py; "
+                        "concurrent per-device enqueues deadlock the "
+                        "collective rendezvous)"))
+            elif (isinstance(node.func, ast.Call)
+                  and _is_shard_map(node.func)
+                  and not _wrapped(node, parents)):
+                if not sf.suppressed(NAME, node.lineno):
+                    findings.append(Finding(
+                        NAME, sf.rel, node.lineno,
+                        "eager jax.shard_map(...)(...) invocation without "
+                        "jit + issue_serialized: multi-device programs "
+                        "must dispatch under the program-issue lock"))
+    return findings

@@ -1,0 +1,206 @@
+"""knob-registry pass: every ``HVD_*`` knob flows through ``utils/envs.py``
+and round-trips with ``docs/knobs.md``.
+
+Invariant (PR 1, ``utils/envs.py``): runtime knob overrides (the
+autotuner) sit *under* the environment, and the override **epoch** is what
+flushes derived state — the dispatch-plan cache keys fusion layouts off
+knob values and compares epochs instead of re-reading knobs per call. A
+direct ``os.environ`` read therefore doesn't just bypass the
+HVD_/HOROVOD_ prefix fallback: it reads a knob the override epoch knows
+nothing about, so tuned values and epoch-driven invalidation silently
+never apply to it. This pass enforces:
+
+1. **no direct reads**: ``os.environ.get``/``[]``/``setdefault`` and
+   ``os.getenv`` with an ``HVD_``/``HOROVOD_`` key are illegal outside
+   ``utils/envs.py`` (writes — seeding worker environments — are the
+   launcher contract and stay legal);
+2. **no literal knob names**: ``envs.get*(...)`` must take a registry
+   constant (``envs.FUSION_THRESHOLD``), not a string literal — literals
+   are invisible to the inventory and typo-prone;
+3. **doc round-trip**: the registry inventory (module-level constants in
+   ``utils/envs.py``) and the ``HVD_*`` names in ``docs/knobs.md`` must
+   match exactly in both directions;
+4. **autotune tunables**: every ``Tunable(...)`` knob argument in
+   ``autotune.py`` must be a registry constant.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Project, dotted_name
+
+NAME = "knob-registry"
+
+_PREFIXES = ("HVD_", "HOROVOD_")
+_GETTERS = ("get", "get_bool", "get_int", "get_float", "require", "set_env")
+_DOC_TOKEN = re.compile(r"HVD_([A-Z][A-Z0-9_]*)")
+
+
+def _literal_env_key(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value.startswith(_PREFIXES):
+            return node.value
+    return None
+
+
+def _check_direct_reads(project: Project, findings: list[Finding]) -> None:
+    envs_rel = f"{project.package_rel}/utils/envs.py"
+    for sf in project.files:
+        if sf.rel == envs_rel:
+            continue
+        for node in ast.walk(sf.tree):
+            key, line = None, None
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in ("os.environ.get", "os.getenv",
+                            "os.environ.setdefault") and node.args:
+                    key = _literal_env_key(node.args[0])
+                    line = node.lineno
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)
+                  and dotted_name(node.value) == "os.environ"):
+                key = _literal_env_key(node.slice)
+                line = node.lineno
+            if key is None or sf.suppressed(NAME, line):
+                continue
+            findings.append(Finding(
+                NAME, sf.rel, line,
+                f"direct os.environ read of {key!r} bypasses the "
+                "utils/envs.py registry: the HOROVOD_ fallback, runtime "
+                "overrides, and the override-epoch invalidation (which "
+                "flushes the dispatch cache) never apply to it — use "
+                f"envs.{key.split('_', 1)[1]} through envs.get*/require"))
+
+
+def _inventory(project: Project) -> dict[str, int]:
+    """Registry inventory: knob name -> envs.py line, from module-level
+    ``NAME = \"KNOB\"`` constants."""
+    envs_sf = project.package_file("utils/envs.py")
+    inv: dict[str, int] = {}
+    if envs_sf is None:
+        return inv
+    for node in envs_sf.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not (isinstance(target, ast.Name) and target.id.isupper()
+                and not target.id.startswith("_")
+                and not target.id.startswith("DEFAULT_")):
+            continue
+        if (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and re.fullmatch(r"[A-Z][A-Z0-9_]*", node.value.value)):
+            inv[node.value.value] = node.lineno
+    return inv
+
+
+def _module_literals(sf) -> dict[str, str]:
+    """Module-level ``NAME = \"literal\"`` bindings (indirection that
+    would otherwise hide a knob name from the inventory check)."""
+    out: dict[str, str] = {}
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _check_getter_args(project: Project, inventory: dict,
+                       findings: list[Finding]) -> None:
+    envs_rel = f"{project.package_rel}/utils/envs.py"
+    for sf in project.files:
+        if sf.rel == envs_rel:
+            continue
+        literals = _module_literals(sf)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in _GETTERS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "envs"):
+                continue
+            arg = node.args[0]
+            if sf.suppressed(NAME, node.lineno):
+                continue
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                findings.append(Finding(
+                    NAME, sf.rel, node.lineno,
+                    f"envs.{func.attr}({arg.value!r}): knob names must be "
+                    "registry constants (envs.<NAME>), not string "
+                    "literals — literals are invisible to the knob "
+                    "inventory and the docs round-trip"))
+            elif (isinstance(arg, ast.Name) and arg.id in literals
+                  and literals[arg.id] not in inventory):
+                findings.append(Finding(
+                    NAME, sf.rel, node.lineno,
+                    f"envs.{func.attr}({arg.id}): resolves to "
+                    f"{literals[arg.id]!r}, which is not registered in "
+                    "utils/envs.py — add the constant there so the "
+                    "inventory and docs/knobs.md stay in lockstep"))
+
+
+def _check_doc_roundtrip(project: Project, inventory: dict,
+                         findings: list[Finding]) -> None:
+    doc_path = project.knobs_doc_path()
+    if not doc_path.exists():
+        findings.append(Finding(
+            NAME, project.knobs_doc_rel, 1,
+            "docs/knobs.md is missing — the knob inventory must be "
+            "documented"))
+        return
+    doc_names: dict[str, int] = {}
+    for i, line in enumerate(doc_path.read_text().splitlines(), start=1):
+        for m in _DOC_TOKEN.finditer(line):
+            doc_names.setdefault(m.group(1), i)
+    envs_rel = f"{project.package_rel}/utils/envs.py"
+    for knob, line in sorted(inventory.items()):
+        if knob not in doc_names:
+            findings.append(Finding(
+                NAME, envs_rel, line,
+                f"knob HVD_{knob} is registered in utils/envs.py but "
+                f"undocumented in {project.knobs_doc_rel}"))
+    for knob, line in sorted(doc_names.items()):
+        if knob not in inventory:
+            findings.append(Finding(
+                NAME, project.knobs_doc_rel, line,
+                f"{project.knobs_doc_rel} documents HVD_{knob}, which is "
+                "not in the utils/envs.py registry (stale entry, or the "
+                "constant is missing)"))
+
+
+def _check_tunables(project: Project, findings: list[Finding]) -> None:
+    sf = project.package_file("autotune.py")
+    if sf is None:
+        return
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Tunable" and node.args):
+            continue
+        arg = node.args[0]
+        ok = (isinstance(arg, ast.Attribute)
+              and isinstance(arg.value, ast.Name)
+              and arg.value.id == "envs")
+        if not ok and not sf.suppressed(NAME, node.lineno):
+            findings.append(Finding(
+                NAME, sf.rel, node.lineno,
+                "Tunable(...) knob must be an envs.<NAME> registry "
+                "constant — the tuner's overrides are keyed by registry "
+                "name, and env-pinning (is_env_fixed) only sees "
+                "registered knobs"))
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    inventory = _inventory(project)
+    _check_direct_reads(project, findings)
+    _check_getter_args(project, inventory, findings)
+    _check_doc_roundtrip(project, inventory, findings)
+    _check_tunables(project, findings)
+    return findings

@@ -1,0 +1,203 @@
+"""lock-order pass: the static ``with <lock>`` nesting graph must be
+acyclic.
+
+The runtime holds 10+ locks across ``fusion_cycle`` (queue mutex +
+executor condition), ``dispatch_cache``, ``autotune``, ``process_sets``,
+``engine_service``, ``timeline``, and ``elastic/``. A consistent global
+acquisition order is what makes that safe; the order exists only by
+convention (e.g. ``fusion_cycle``'s documented one-way ``_mu ->
+_exec_cv`` nesting). This pass extracts the acquisition-order graph
+statically and fails on any cycle:
+
+* a ``with A:`` lexically containing ``with B:`` adds edge ``A -> B``;
+* a call made while holding ``A`` to a project function that (transitively,
+  through resolvable calls) acquires ``B`` adds ``A -> B`` as well —
+  cross-module nesting is where conventions rot first.
+
+Lock identity is ``module::Class.attr`` (or ``module::name`` for
+module-level locks); ``with`` context expressions whose final attribute
+looks lock-like (``lock`` / ``mu`` / ``mutex`` / ``cv`` / ``cond``) are
+treated as locks — the same naming convention
+``horovod_tpu.utils.invariants.make_lock`` enforces at runtime. The
+runtime twin of this pass is the ``HVD_DEBUG_INVARIANTS=1`` lock-order
+witness, which checks the *dynamic* acquisition order with stacks.
+
+The transitive-call edge is an over-approximation (a callee may acquire
+only on an unreached branch); suppress a vetted false positive with
+``# hvdlint: disable=lock-order`` on the inner ``with`` or call line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, FuncInfo, Project, dotted_name
+
+NAME = "lock-order"
+
+_LOCKISH = ("lock", "mutex", "mu", "cv", "cond")
+
+
+def _is_lockish(last_segment: str) -> bool:
+    seg = last_segment.lower()
+    return any(tok in seg for tok in _LOCKISH)
+
+
+def _lock_id(project: Project, info: FuncInfo, expr: ast.AST,
+             aliases: dict[str, str]) -> str | None:
+    """Identity of a ``with`` context expression when it looks like a
+    lock; None otherwise (calls — e.g. ``with timeline.op_range(...)`` —
+    are never locks here)."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if not _is_lockish(parts[-1]):
+        return None
+    if parts[0] in ("self", "cls"):
+        owner = info.class_name or "?"
+        return f"{info.file.rel}::{owner}.{'.'.join(parts[1:])}"
+    if parts[0] in aliases and len(parts) > 1:
+        return f"{aliases[parts[0]]}::{'.'.join(parts[1:])}"
+    return f"{info.file.rel}::{name}"
+
+
+class _FuncFacts:
+    """Per-function lock facts: every lock acquired directly, and the
+    (held-lock -> nested-lock / held-lock -> callee) observations."""
+
+    def __init__(self):
+        self.direct: set[str] = set()  # locks acquired anywhere in the fn
+        # (held lock, lock, file, line) for lexically nested withs
+        self.nested: list[tuple[str, str, str, int]] = []
+        # (held lock, callee FuncInfo key, file, line)
+        self.calls_under: list[tuple[str, tuple, str, int]] = []
+        self.callees: set[tuple] = set()  # all resolvable callees
+
+
+def _collect(project: Project, info: FuncInfo) -> _FuncFacts:
+    facts = _FuncFacts()
+    aliases = project.func_imports(info)
+    sf = info.file
+
+    def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs when called, not under the locks
+            # lexically surrounding the def — analyze it with no held
+            # locks but fold its facts into this function (closures are
+            # not in the module-level function index)
+            for sub in node.body:
+                visit(sub, ())
+            return
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                visit(item.context_expr, held)
+                lid = _lock_id(project, info, item.context_expr, aliases)
+                if lid is None:
+                    continue
+                facts.direct.add(lid)
+                if not sf.suppressed(NAME, node.lineno):
+                    for h in held:
+                        if h != lid:
+                            facts.nested.append((h, lid, sf.rel,
+                                                 node.lineno))
+                inner = inner + (lid,)
+            for sub in node.body:
+                visit(sub, inner)
+            return
+        if isinstance(node, ast.Call):
+            callee = project.resolve_call(info, node, aliases)
+            if callee is not None:
+                facts.callees.add(callee.key)
+                if held and not sf.suppressed(NAME, node.lineno):
+                    for h in held:
+                        facts.calls_under.append(
+                            (h, callee.key, sf.rel, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in info.node.body:
+        visit(stmt, ())
+    return facts
+
+
+def _acquire_closure(facts_by_key, key, memo, visiting) -> set[str]:
+    if key in memo:
+        return memo[key]
+    if key in visiting:
+        return set()  # call-graph cycle: closed over by the caller
+    visiting.add(key)
+    facts = facts_by_key.get(key)
+    acquired = set(facts.direct) if facts else set()
+    if facts:
+        for callee in facts.callees:
+            acquired |= _acquire_closure(facts_by_key, callee, memo,
+                                         visiting)
+    visiting.discard(key)
+    memo[key] = acquired
+    return acquired
+
+
+def run(project: Project) -> list[Finding]:
+    facts_by_key = {}
+    for info in project.functions():
+        facts_by_key[info.key] = _collect(project, info)
+
+    # edge (a, b) -> first (file, line, kind) observation
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    memo: dict = {}
+    for key, facts in facts_by_key.items():
+        for h, lid, rel, line in facts.nested:
+            edges.setdefault((h, lid), (rel, line, "nested with"))
+        for h, callee, rel, line in facts.calls_under:
+            for lid in _acquire_closure(facts_by_key, callee, memo, set()):
+                if lid != h:
+                    edges.setdefault(
+                        (h, lid),
+                        (rel, line, f"call into {callee[1]} ({callee[0]})"))
+
+    # cycle detection over the lock digraph
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    findings: list[Finding] = []
+    seen_cycles: set[frozenset] = set()
+
+    def dfs(node, stack, on_stack, visited):
+        visited.add(node)
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    findings.append(_cycle_finding(cycle, edges))
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    return findings
+
+
+def _cycle_finding(cycle: list[str], edges) -> Finding:
+    hops = []
+    anchor = ("", 0)
+    for a, b in zip(cycle, cycle[1:]):
+        rel, line, kind = edges[(a, b)]
+        if not anchor[0]:
+            anchor = (rel, line)
+        hops.append(f"{a} -> {b} [{kind} at {rel}:{line}]")
+    return Finding(
+        NAME, anchor[0], anchor[1],
+        "lock acquisition-order cycle (deadlock risk): "
+        + "; ".join(hops))

@@ -1,0 +1,149 @@
+"""timer-purity pass: code reachable from the cycle timer thread must be
+rank-deterministic.
+
+Invariant (PRs 2-3, docs/fusion_cycle.md): flush *composition* — what ends
+up in each dispatched program — must be identical on every rank, derived
+from submission order and submission-time negotiation names only. The
+cycle timer (``FusionScheduler._loop``, pacing ``HVD_CYCLE_TIME`` /
+``HVD_PENDING_CYCLE_TIME``) fires on wall-clock jitter that differs per
+process, so everything it can reach must be composition-pure:
+
+* no ``negotiate`` / ``negotiate_many*`` calls (negotiation order from a
+  jittery timer would desynchronize the KV rounds across processes —
+  the timer must never drain svc-backed queues);
+* no wall-clock reads (``time.time`` / ``time.time_ns`` /
+  ``datetime.now``) — ``time.monotonic`` / ``time.sleep`` are exempt:
+  they pace *when* a single-controller flush fires, which is free to
+  jitter, never *what* is composed;
+* no ``random`` (stdlib or numpy) draws;
+* no iteration over Python ``set`` values (unordered iteration feeding
+  batch order is rank-nondeterministic; sets are fine as membership
+  guards — ``isdisjoint`` / ``in`` — just not as ``for`` sources).
+
+Traversal starts at the timer callback (``FusionScheduler._loop``, plus
+any ``def`` carrying a ``# hvdlint: timer-root`` marker) and follows
+resolvable project calls. A ``# hvdlint: timer-boundary`` marker on a
+``def`` stops traversal there — used for entry points that are
+dynamically unreachable from the timer for svc queues (the ``_loop``
+skip) or trivially rank-consistent (single-controller dispatch); each
+in-tree marker documents its justification. A statically-reachable but
+dynamically-guarded banned call is suppressed at the call line with
+``# hvdlint: disable=timer-purity``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, FuncInfo, Project, dotted_name
+
+NAME = "timer-purity"
+
+ROOT_MARKER = "timer-root"
+BOUNDARY_MARKER = "timer-boundary"
+
+DEFAULT_ROOTS = (("ops/fusion_cycle.py", "FusionScheduler._loop"),)
+
+_WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
+              "datetime.datetime.now", "datetime.utcnow",
+              "datetime.datetime.utcnow"}
+
+
+def _banned_call(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if last.startswith("negotiate"):
+        return (f"'{name}': negotiation from timer-reachable code — flush "
+                "composition would depend on per-process timer jitter")
+    if name in _WALLCLOCK:
+        return (f"'{name}': wall-clock read in timer-reachable code (use "
+                "time.monotonic for pacing; composition must not read "
+                "clocks)")
+    if "random" in parts[:-1] or parts[0] == "random":
+        return (f"'{name}': randomness in timer-reachable code is "
+                "rank-nondeterministic")
+    return None
+
+
+def _set_typed_names(fn: ast.FunctionDef) -> set[str]:
+    """Local names bound to an obvious set value anywhere in ``fn``."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _is_set_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id in ("set", "frozenset"))
+
+
+def _iter_sources(fn: ast.FunctionDef):
+    """(iter-expr, lineno) of every for-loop and comprehension source."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For):
+            yield node.iter, node.lineno
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                yield gen.iter, getattr(gen.iter, "lineno", node.lineno)
+
+
+def _roots(project: Project) -> list[FuncInfo]:
+    roots: list[FuncInfo] = []
+    for tail, qual in DEFAULT_ROOTS:
+        info = project.func(f"{project.package_rel}/{tail}", qual)
+        if info is not None:
+            roots.append(info)
+    for info in project.functions():
+        if info.file.has_marker(ROOT_MARKER, info.node.lineno):
+            roots.append(info)
+    return roots
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    visited: set[tuple] = set()
+    queue = list(_roots(project))
+    root_keys = {i.key for i in queue}
+    while queue:
+        info = queue.pop()
+        if info.key in visited:
+            continue
+        visited.add(info.key)
+        if (info.key not in root_keys
+                and info.file.has_marker(BOUNDARY_MARKER, info.node.lineno)):
+            continue
+        sf = info.file
+        aliases = project.func_imports(info)
+        set_names = _set_typed_names(info.node)
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                why = _banned_call(node)
+                if why is not None and not sf.suppressed(NAME, node.lineno):
+                    findings.append(Finding(
+                        NAME, sf.rel, node.lineno,
+                        f"timer-reachable (via {info.qualname}): {why}"))
+                callee = project.resolve_call(info, node, aliases)
+                if callee is not None:
+                    queue.append(callee)
+        for src, lineno in _iter_sources(info.node):
+            if (_is_set_expr(src)
+                    or (isinstance(src, ast.Name) and src.id in set_names)):
+                if not sf.suppressed(NAME, lineno):
+                    findings.append(Finding(
+                        NAME, sf.rel, lineno,
+                        f"timer-reachable (via {info.qualname}): iteration "
+                        "over an unordered set — batch order derived from "
+                        "set order is rank-nondeterministic (sort first, "
+                        "or keep a list)"))
+    return findings
