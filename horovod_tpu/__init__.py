@@ -115,7 +115,12 @@ from .functions import (
     broadcast_parameters,
     broadcast_variables,
 )
-from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    PeerFailureError,
+)
+from .health import health_stats
 from .timeline import start_timeline, stop_timeline
 from . import autotune
 from . import callbacks
@@ -164,6 +169,7 @@ __all__ = [
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
+    "PeerFailureError", "health_stats",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
     "checkpoint", "data", "elastic", "parallel", "average_metrics",
     "metric_average", "SyncBatchNorm", "__version__",

@@ -420,8 +420,8 @@ class KVScoreSync:
                     f"{self.prefix}/score/{sample_idx - 1}/{self.rank}")
                 if self.rank == 0:
                     self.kv.delete(f"{self.prefix}/decision/{sample_idx - 1}")
-            except Exception:
-                pass
+            except Exception:  # hvdlint: disable=silent-except
+                pass  # best-effort memory bound; stale keys are harmless
         return decision
 
 

@@ -237,8 +237,8 @@ class NativeEngine:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # hvdlint: disable=silent-except
+            pass  # GC-time close: logging may itself be torn down
 
     # -- worker side -------------------------------------------------------
 

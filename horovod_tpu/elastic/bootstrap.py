@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import pickle
 
+from .. import health
 from ..runner.http_kv import KVServer, local_addresses, make_secret
 from ..utils import envs
 from .driver import (
@@ -71,7 +72,7 @@ def make_elastic_infra(discovery, min_np: int, max_np: int | None = None,
     secret = make_secret()
     driver_holder: list[ElasticDriver] = []
 
-    def on_put(key: str, _payload: bytes) -> None:
+    def on_put(key: str, payload: bytes) -> None:
         # Completion-by-KV decouples job success from the exit-code race
         # during distributed-runtime teardown.
         if not driver_holder:
@@ -83,6 +84,14 @@ def make_elastic_infra(discovery, min_np: int, max_np: int | None = None,
         parsed = parse_done_key(key)
         if parsed is not None:
             driver_holder[0].registry.record_success(*parsed)
+            return
+        # Peer-failure reports from worker health watchdogs
+        # (horovod_tpu/health.py): blacklist the dead rank's host and
+        # re-form the round immediately instead of waiting for the dead
+        # process's exit to be reaped.
+        failed = health.parse_peer_failure(key, payload)
+        if failed is not None:
+            driver_holder[0].record_peer_failure(*failed)
 
     kv = KVServer(secret=secret, on_put=on_put)
     kv_port = kv.start()

@@ -33,7 +33,9 @@ import time
 
 from ..runner import hosts as hosts_mod
 from ..utils import envs
+from ..utils import faults as _faults
 from ..utils import logging as hvd_logging
+from ..utils import retry as _retry
 from .discovery import HostManager
 from .registration import WorkerStateRegistry
 from .state import HostUpdateResult
@@ -252,12 +254,15 @@ class ElasticDriver:
         post-training work before stragglers are terminated."""
         while not self._shutdown.wait(0.2):
             pass
-        deadline = time.monotonic() + self.GRACE_PERIOD_S
-        while time.monotonic() < deadline:
-            with self._proc_lock:
-                if not self._active_procs:
-                    break
-            time.sleep(0.2)
+        done = False
+        with self._proc_lock:
+            done = not self._active_procs
+        if not done:
+            for _ in _retry.poll_intervals("elastic.grace", interval_s=0.2,
+                                           deadline_s=self.GRACE_PERIOD_S):
+                with self._proc_lock:
+                    if not self._active_procs:
+                        break
         self._terminate_active()
         for t in list(self._result_threads):
             t.join(timeout=30)
@@ -505,8 +510,53 @@ class ElasticDriver:
                              slot_info.rank, spec_round)
             self._start_worker_process(slot_info, spec_round)
 
+    def record_peer_failure(self, dead_rank: int, reason: str) -> None:
+        """A surviving worker's health watchdog reported ``dead_rank``
+        dead (poison/beat-timeout record on the launcher KV, parsed by
+        the bootstrap PUT observer): convert the coordinated abort into
+        a registry failure so the dead host is blacklisted and
+        :meth:`resume` re-forms the round NOW — without waiting for the
+        dead process to be reaped by its exit waiter."""
+        slot = self._rank_assignments.get(dead_rank)
+        if slot is None:
+            hvd_logging.warning(
+                "peer-failure report for unassigned rank %d (%s); ignoring",
+                dead_rank, reason)
+            return
+        hvd_logging.error(
+            "worker %s[%d] (rank %d) reported dead by a peer watchdog: %s",
+            slot.hostname, slot.local_rank, dead_rank, reason)
+        # From a fresh thread, like a process-exit waiter: this is called
+        # by the KV server's PUT observer, and the resume() a failure can
+        # trigger may block on slot availability — the reporting worker's
+        # PUT must not hang on it.
+        t = threading.Thread(
+            target=self._worker_registry.record_failure,
+            args=(slot.hostname, slot.local_rank),
+            daemon=True, name=f"hvd-elastic-peerfail-{dead_rank}")
+        t.start()
+        self._result_threads.append(t)
+
     def _start_worker_process(self, slot_info, spec_round: int) -> None:
-        proc = self._create_worker_fn(slot_info, spec_round)
+        try:
+            _faults.inject("worker.launch", rank=slot_info.rank)
+            proc = self._create_worker_fn(slot_info, spec_round)
+        except Exception as e:
+            # A failed spawn used to unwind the whole round transition;
+            # treat it like an instant worker failure instead — the
+            # registry blacklists the host and resumes with the rest.
+            # Recorded from a fresh thread, exactly like an exit-waiter
+            # thread would, so the (re-entrant) round lock the caller
+            # holds is not re-acquired deeper on this stack.
+            hvd_logging.error("failed to start worker %s[%d]: %s",
+                              slot_info.hostname, slot_info.local_rank, e)
+            t = threading.Thread(
+                target=self._worker_registry.record_failure,
+                args=(slot_info.hostname, slot_info.local_rank),
+                daemon=True, name=f"hvd-elastic-spawnfail-{slot_info.rank}")
+            t.start()
+            self._result_threads.append(t)
+            return
         key = (slot_info.hostname, slot_info.local_rank)
         with self._proc_lock:
             self._active_procs[key] = proc

@@ -26,6 +26,7 @@ import time
 
 from ..utils import envs
 from ..utils import logging as hvd_logging
+from ..utils import retry as _retry
 from .driver import (
     ROUND_KEY,
     ROUND_SPEC_KEY,
@@ -60,6 +61,7 @@ class WorkerRendezvous:
         self.slot = envs.get_int(envs.LOCAL_RANK, 0)
         self.round = envs.get_int(envs.ELASTIC_ROUND, 1)
         self.timeout = envs.get_int(envs.ELASTIC_TIMEOUT, 600)
+        self._last_round_raw: bytes | None = None
 
     # -- protocol ----------------------------------------------------------
 
@@ -83,31 +85,46 @@ class WorkerRendezvous:
             sys.exit(SLOT_LOST_EXIT_CODE)
         self._reinitialize(spec, my_slot)
 
+    def _check_round(self) -> dict | None:
+        """One poll of the round protocol: exits on a driver stop, returns
+        the next round's spec when published, else None."""
+        if self.kv.get(STOP_KEY) is not None:
+            hvd_logging.info("driver stopped the job during reset")
+            sys.exit(0)
+        raw = self.kv.get(ROUND_KEY)
+        self._last_round_raw = raw
+        if raw is not None:
+            round_id = int(raw.decode())
+            if round_id > self.round:
+                spec_raw = self.kv.get(ROUND_SPEC_KEY.format(round_id))
+                if spec_raw is not None:
+                    return pickle.loads(spec_raw)
+        return None
+
     def _wait_for_next_round(self) -> dict:
-        deadline = time.monotonic() + self.timeout
+        # Paced by the unified retry helper: jittered 250 ms polls backing
+        # off toward 2 s — host replacement takes tens of seconds, so the
+        # old fixed-interval spin bought nothing but KV load.
         last_report = time.monotonic()
-        while True:
-            if self.kv.get(STOP_KEY) is not None:
-                hvd_logging.info("driver stopped the job during reset")
-                sys.exit(0)
-            raw = self.kv.get(ROUND_KEY)
-            if raw is not None:
-                round_id = int(raw.decode())
-                if round_id > self.round:
-                    spec_raw = self.kv.get(ROUND_SPEC_KEY.format(round_id))
-                    if spec_raw is not None:
-                        return pickle.loads(spec_raw)
+        spec = self._check_round()
+        if spec is not None:
+            return spec
+        for _ in _retry.poll_intervals("elastic.round-wait",
+                                       interval_s=0.25,
+                                       deadline_s=float(self.timeout)):
+            spec = self._check_round()
+            if spec is not None:
+                return spec
             now = time.monotonic()
             if now - last_report > 5:
+                raw = self._last_round_raw
                 hvd_logging.info(
                     "waiting for elastic round > %d (kv reports %s)",
                     self.round, raw.decode() if raw else None)
                 last_report = now
-            if now > deadline:
-                raise TimeoutError(
-                    f"no new elastic round after {self.timeout}s "
-                    f"(stuck at round {self.round})")
-            time.sleep(0.25)
+        raise TimeoutError(
+            f"no new elastic round after {self.timeout}s "
+            f"(stuck at round {self.round})")
 
     def _find_my_slot(self, spec: dict) -> dict | None:
         for slot in spec["slots"]:

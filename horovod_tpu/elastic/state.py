@@ -21,6 +21,7 @@ import functools
 import queue
 
 from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils import faults as _faults
 
 
 class HostUpdateResult(enum.IntFlag):
@@ -45,6 +46,7 @@ class State:
         self._host_messages: queue.Queue = queue.Queue()
         self._last_updated_timestamp = 0
         self._reset_callbacks = []
+        self._commits = 0
 
     def register_reset_callbacks(self, callbacks):
         """Register callbacks invoked after every reset event — e.g. rescale
@@ -65,6 +67,12 @@ class State:
         set changed. Committing copies device arrays to host memory, so
         committing less often than every batch trades throughput against
         lost steps on failure (same trade-off as the reference)."""
+        self._commits += 1
+        # Chaos seam ("worker" site): `worker:crash:rank=R:at_step=N`
+        # hard-exits rank R at its N-th commit — the rehearsal for the
+        # whole elastic recovery chain (watchdog -> PeerFailureError ->
+        # blacklist -> re-formed round). No-op with HVD_FAULT_SPEC unset.
+        _faults.inject("worker", rank=self._rank(), step=self._commits)
         self.save()
         self.check_host_updates()
 

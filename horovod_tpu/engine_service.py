@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 import time
 
+from . import health as _health
 from . import timeline as _timeline
 from .dynamic import (
     HorovodCollectiveError,
@@ -34,6 +35,7 @@ from .dynamic import (
     and_bitvectors,
 )
 from .utils import envs
+from .utils import faults as _faults
 from .utils import logging as hvd_logging
 
 # Default cycle time over the HTTP KV transport. The reference's 1 ms
@@ -76,6 +78,7 @@ class KVTransport:
                  timeout: float) -> tuple[list[bytes], list[bytes]]:
         """One round: publish (requests, bits), collect everyone's."""
         import struct
+        _faults.inject("svc.exchange")
         frame = struct.pack("<I", len(req_bytes)) + req_bytes + bits
         self.kv.put(f"{self.prefix}/x/{cycle}/{self.rank}", frame)
         got = self.kv.gather(f"{self.prefix}/x/{cycle}", self.world_size,
@@ -93,8 +96,8 @@ class KVTransport:
         if cycle > 0:
             try:
                 self.kv.delete(f"{self.prefix}/x/{cycle - 1}/{self.rank}")
-            except Exception:
-                pass
+            except Exception:  # hvdlint: disable=silent-except
+                pass  # best-effort memory bound; next cycle retries the key
         return datas, bitvs
 
 
@@ -128,7 +131,7 @@ class DynamicService:
     background thread."""
 
     def __init__(self, engine: NativeEngine, transport,
-                 cycle_time_s: float | None = None):
+                 cycle_time_s: float | None = None, global_ranks=None):
         self.engine = engine
         self.transport = transport
         # With no explicit value the knob is re-read every cycle so the
@@ -144,10 +147,29 @@ class DynamicService:
         self._pending: dict[str, _Pending] = {}
         self._joined = False
         self._failure: str | None = None
+        self._failure_exc: Exception | None = None
         self._shutdown = threading.Event()
         self._tick = threading.Event()  # fresh work: skip the cycle sleep
         self._exchange_timeout = envs.get_float(envs.ELASTIC_TIMEOUT, 600.0)
         self._last_stall_check = time.monotonic()
+        # Health watchdog over the same KV channel the transport uses:
+        # liveness beats + poison records turn a dead peer into a
+        # PeerFailureError on every waiter in ~HVD_HEALTH_TIMEOUT instead
+        # of the full exchange deadline (docs/robustness.md). Only real
+        # KV transports carry it; in-memory test transports have no .kv.
+        self._watchdog: _health.HealthWatchdog | None = None
+        kv = getattr(transport, "kv", None)
+        if (kv is not None and _health.enabled()
+                and getattr(transport, "world_size", 1) > 1):
+            self._watchdog = _health.HealthWatchdog(
+                kv, transport.world_size, transport.rank,
+                prefix=f"{getattr(transport, 'prefix', 'engine')}/health",
+                on_failure=self._on_peer_failure,
+                # Per-set services run on transport-local indices; the
+                # watchdog reports failures in GLOBAL process ranks so
+                # the elastic driver blacklists the right host.
+                global_ranks=global_ranks)
+            self._watchdog.start()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="hvd-engine-cycle")
         self._thread.start()
@@ -203,10 +225,18 @@ class DynamicService:
         batch (waking the cycle loop) without waiting. The negotiation
         round proceeds on the cycle thread; the returned ticket must be
         consumed by ``negotiate_many_wait`` or ``negotiate_many_cancel``."""
-        if self._failure:
-            raise HorovodCollectiveError(self._failure)
+        _faults.inject("svc.submit")
         pends = []
         with self._mu:
+            # Failure check under the SAME lock that inserts the pends:
+            # _fail_all snapshots self._pending under _mu, so a submission
+            # racing a coordinated abort either sees the failure here or
+            # lands its pends before the snapshot and is failed with the
+            # rest — never registered-after-snapshot with no one left to
+            # set its events (that waiter would block out the full
+            # exchange deadline, the exact hang the watchdog removes).
+            if self._failure:
+                raise self._failure_error()
             for req in requests:
                 name = req["name"]
                 if name in self._pending:
@@ -275,10 +305,21 @@ class DynamicService:
                     continue
                 if remaining <= 0 or not pend.event.wait(remaining):
                     timed_out = True
+                    # Name the actual debt: which tensors of this batch
+                    # never got a plan, and when each peer was last seen
+                    # alive — "see stall warnings in the log" made the
+                    # operator go digging for what the error already knew.
+                    undelivered = sorted(
+                        r["name"] for r, p in zip(requests, pends)
+                        if p.response is None)
+                    liveness = (self._watchdog.describe_peers()
+                                if self._watchdog is not None
+                                else "health watchdog off")
                     raise HorovodCollectiveError(
                         f"negotiation of {req['name']!r} timed out after "
-                        f"{deadline}s (some processes never submitted it; "
-                        "see stall warnings in the log)")
+                        f"{deadline}s (some processes never submitted it). "
+                        f"Undelivered tensors: {undelivered}; "
+                        f"peer liveness: {liveness}")
         finally:
             for req in requests:
                 _timeline.record(req["name"], _timeline.NEGOTIATE,
@@ -296,8 +337,10 @@ class DynamicService:
         for req, pend in zip(requests, pends):
             resp = pend.response
             if resp is None:
+                if self._failure:
+                    raise self._failure_error()
                 raise HorovodCollectiveError(
-                    self._failure or f"negotiation of {req['name']!r} aborted")
+                    f"negotiation of {req['name']!r} aborted")
             if resp.is_error:
                 raise HorovodCollectiveError(resp.error_message)
             out.append(resp)
@@ -318,24 +361,59 @@ class DynamicService:
                 if pend.response is None:
                     try:
                         self.engine.abandon(req["name"])
-                    except Exception:
+                    except Exception:  # hvdlint: disable=silent-except
                         pass  # engine may already be torn down
 
     def stop(self):
         self._shutdown.set()
         self._tick.set()  # the adaptive sleep waits on _tick, not _shutdown
+        if self._watchdog is not None:
+            self._watchdog.stop()
         self._thread.join(timeout=10)
         self._fail_all("engine service stopped")
 
+    def health_watchdog(self) -> _health.HealthWatchdog | None:
+        return self._watchdog
+
     # -- internals ---------------------------------------------------------
 
-    def _fail_all(self, message: str):
-        self._failure = message
+    def _failure_error(self) -> Exception:
+        return (self._failure_exc
+                if self._failure_exc is not None
+                else HorovodCollectiveError(self._failure or "service failed"))
+
+    def _fail_all(self, message: str, exc: Exception | None = None):
         with self._mu:
+            # Failure state and the pending snapshot commit atomically
+            # (see negotiate_many_submit): any submission not failed by
+            # this snapshot observes self._failure and raises.
+            if exc is not None and self._failure_exc is None:
+                self._failure_exc = exc
+            self._failure = message
             pend = list(self._pending.values())
             self._pending.clear()
         for p in pend:
             p.event.set()
+
+    def _on_peer_failure(self, dead_rank: int, reason: str) -> None:
+        """Watchdog callback: coordinated abort. Ordering matters and
+        mirrors the PR-3 pipeline contract (docs/robustness.md): set the
+        failure FIRST (new submissions raise immediately), then unblock
+        every in-flight ticket waiter, then abort the fusion executor so
+        queued-but-unsubmitted batches fail and their tickets are
+        cancelled — no waiter can hang on a flush that will never run."""
+        with self._mu:
+            owed = sorted(self._pending)
+        exc = _health.make_peer_failure_error(dead_rank, reason, owed)
+        _timeline.record_health_event(f"PEER_DEAD.{dead_rank}")
+        self._fail_all(str(exc), exc)
+        from .ops import fusion_cycle
+        aborted = fusion_cycle.abort(str(exc))
+        if aborted:
+            hvd_logging.warning(
+                "peer failure aborted %d queued async collectives", aborted)
+        self._shutdown.set()
+        self._tick.set()
 
     def _loop(self):
         while not self._shutdown.is_set():
@@ -347,6 +425,14 @@ class DynamicService:
                 self._run_cycle()
             except Exception as e:
                 hvd_logging.exception("engine cycle failed")
+                # Poison BEFORE failing local waiters: this process is
+                # alive (its beats keep flowing from the watchdog thread),
+                # so without an explicit record peers would only notice
+                # at the exchange deadline. The poison key fails them
+                # within one monitor tick.
+                if self._watchdog is not None:
+                    _timeline.record_health_event("POISON")
+                    self._watchdog.poison(f"engine cycle failed: {e}")
                 self._fail_all(f"engine negotiation failed: {e}")
                 return
             if self._cycle_time_from_knob:
@@ -521,7 +607,8 @@ def get_service(pset=None) -> DynamicService | None:
                 envs.get(envs.COORDINATOR_PORT, "0"), key)
             transport = KVTransport(kv, len(member_procs),
                                     member_procs.index(me), prefix=prefix)
-            svc = DynamicService(engine, transport)
+            svc = DynamicService(engine, transport,
+                                 global_ranks=member_procs)
             _services[key] = svc
             hvd_logging.info(
                 "dynamic engine service started for set %s: %d processes "

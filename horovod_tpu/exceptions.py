@@ -20,6 +20,29 @@ class HorovodInternalError(RuntimeError):
     """
 
 
+class PeerFailureError(HorovodInternalError):
+    """A peer rank was declared dead by the health watchdog — it stopped
+    publishing liveness beats for ``HVD_HEALTH_TIMEOUT`` seconds, or it
+    wrote an explicit poison record after catching a local error.
+
+    Raised on every surviving rank's in-flight negotiation waits (and on
+    queued fusion-cycle handles at ``synchronize()``) well before the
+    600 s exchange deadline would expire, naming the dead rank and the
+    tensors it still owed. Subclasses :class:`HorovodInternalError` so
+    elastic mode (``hvd.elastic.run``) treats it as recoverable: restore
+    committed state, re-rendezvous without the dead host, resume.
+    """
+
+    def __init__(self, rank: int, reason: str, owed_tensors=()):
+        self.rank = rank
+        self.reason = reason
+        self.owed_tensors = tuple(owed_tensors)
+        owed = (f"; undelivered tensors: {list(self.owed_tensors)}"
+                if self.owed_tensors else "")
+        super().__init__(
+            f"peer rank {rank} failed: {reason}{owed}")
+
+
 class HostsUpdatedInterrupt(RuntimeError):
     """Internal interrupt raised when the set of available hosts changed.
 

@@ -1,0 +1,367 @@
+"""Health watchdog: liveness beats, poison records, coordinated abort.
+
+The failure-detection half of the failure domain (docs/robustness.md).
+The reference's stall inspector (``stall_inspector.h:71-86``) only
+*notices* a missing peer after a collective is already waiting on it;
+a dead rank here additionally left the survivors blocked for the full
+600 s ``HVD_ELASTIC_TIMEOUT`` exchange deadline. The watchdog closes
+that gap with two signals over the launcher KV channel the runtime
+already owns:
+
+* **beats** — every rank PUTs a monotonically increasing counter under
+  ``<prefix>/beat/<rank>`` each ``HVD_HEALTH_INTERVAL`` seconds. Peers
+  track *when the counter last changed on their own monotonic clock*,
+  so clock skew between hosts cannot fake a death. No change for
+  ``HVD_HEALTH_TIMEOUT`` seconds declares the peer dead.
+* **poison** — a rank whose negotiation loop caught a local error PUTs
+  ``<prefix>/poison/<rank>`` with the reason. Its process (and its
+  beats) may well still be alive — poison is the fast path for "alive
+  but broken", detected on the next monitor tick instead of after the
+  beat timeout.
+
+On either signal the owner's ``on_failure(rank, reason)`` callback runs
+exactly once; the engine service uses it to fail every in-flight ticket
+with :class:`~horovod_tpu.exceptions.PeerFailureError` (naming the dead
+rank and the tensors it owed), drive the fusion executor's ``abort()``
+so no pipelined waiter hangs, and — in elastic workers — publish a
+peer-failure record the driver converts into a registry failure, so
+``ElasticDriver.resume()`` re-forms the round instead of wedging.
+
+``hvd.health_stats()`` aggregates the watchdog state with the retry and
+fault-injection counters.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .exceptions import PeerFailureError
+from .utils import envs
+from .utils import faults as _faults
+from .utils import logging as hvd_logging
+from .utils import retry as _retry
+
+# Driver-side conversion channel: an elastic worker that detected a peer
+# death publishes {"dead_rank": r, "reason": ...} here; the launcher KV
+# observer (elastic/bootstrap.py) hands it to
+# ElasticDriver.record_peer_failure, which blacklists the dead rank's
+# host and resumes — without waiting for the dead process to be reaped.
+PEER_FAILURE_KEY_PREFIX = "health/peerfail/"
+
+
+def peer_failure_key(reporter_rank: int) -> str:
+    return f"{PEER_FAILURE_KEY_PREFIX}{reporter_rank}"
+
+
+def parse_peer_failure(key: str, payload: bytes):
+    """``(dead_rank, reason)`` if ``key`` records a peer failure, else
+    None (malformed records are ignored — the process-exit path still
+    catches the failure)."""
+    if not key.startswith(PEER_FAILURE_KEY_PREFIX):
+        return None
+    try:
+        body = json.loads(payload.decode())
+        return int(body["dead_rank"]), str(body.get("reason", ""))
+    except (ValueError, KeyError, UnicodeDecodeError):
+        return None
+
+
+def enabled() -> bool:
+    """The watchdog runs whenever beats are on (``HVD_HEALTH_INTERVAL``
+    > 0; set 0 to disable)."""
+    return envs.health_interval_s() > 0.0
+
+
+class HealthWatchdog:
+    """One rank's view of its peers' liveness over a shared KV store.
+
+    ``kv`` needs ``put(key, bytes)`` / ``get(key) -> bytes|None``;
+    both the worker-side :class:`~horovod_tpu.runner.http_kv.KVClient`
+    and the server-side store satisfy it. A single daemon thread both
+    publishes this rank's beat and monitors the peers — beat and check
+    cadence are the same knob, so a beat can never be starved by its
+    own monitor."""
+
+    def __init__(self, kv, world_size: int, rank: int, prefix: str,
+                 on_failure, interval_s: float | None = None,
+                 timeout_s: float | None = None, global_ranks=None):
+        self.kv = kv
+        self.world_size = world_size
+        self.rank = rank
+        self.prefix = prefix.rstrip("/")
+        self.on_failure = on_failure
+        self.interval_s = (interval_s if interval_s is not None
+                           else envs.health_interval_s())
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else envs.health_timeout_s())
+        # Beat keys and internal tracking use transport-LOCAL indices
+        # (consistent across the members of a per-process-set service);
+        # everything outward-facing — on_failure, error messages, the
+        # driver-side peer-failure report — speaks GLOBAL process ranks
+        # via this map, else a subset service would name (and blacklist)
+        # the wrong process.
+        self.global_ranks = (list(global_ranks) if global_ranks is not None
+                             else list(range(world_size)))
+        self._beat = 0
+        self._beats_sent = 0
+        self._beat_errors = 0
+        # peer local rank -> (last counter value, monotonic time it
+        # advanced). changed_at None = never beaten: silence detection
+        # only arms after a peer's FIRST beat — service creation is lazy
+        # (first collective), so ranks legitimately start minutes apart
+        # and a startup clock would false-positive a healthy job. A rank
+        # that dies before ever beating is still covered by the stall
+        # inspector / exchange deadline, exactly as before this PR.
+        self._seen: dict[int, tuple[int | None, float | None]] = {}
+        self._failed: tuple[int, str] | None = None
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        with self._mu:
+            for r in range(self.world_size):
+                if r != self.rank:
+                    self._seen[r] = (None, None)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"hvd-health-{self.rank}")
+        self._thread.start()
+        _register(self)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._thread = None
+        _unregister(self)
+
+    # -- protocol ----------------------------------------------------------
+
+    def _beat_key(self, rank: int) -> str:
+        return f"{self.prefix}/beat/{rank}"
+
+    def _poison_key(self, rank: int) -> str:
+        return f"{self.prefix}/poison/{rank}"
+
+    def poison(self, reason: str) -> None:
+        """Publish an explicit poison record for THIS rank (it caught a
+        local error peers cannot see): every peer's watchdog fails fast
+        on its next tick instead of waiting out the beat timeout."""
+        try:
+            self.kv.put(self._poison_key(self.rank), reason.encode())
+        except Exception as e:
+            hvd_logging.warning("health: poison publish failed: %s", e)
+
+    def report_peer_failure(self, dead_rank: int, reason: str) -> None:
+        """Elastic conversion: record the death on the launcher KV so the
+        driver blacklists the dead host without waiting for process
+        reaping (no-op outside elastic workers)."""
+        if not envs.get_bool(envs.ELASTIC):
+            return
+        try:
+            self.kv.put(peer_failure_key(self.rank), json.dumps(
+                {"dead_rank": dead_rank, "reason": reason}).encode())
+        except Exception as e:
+            hvd_logging.warning(
+                "health: peer-failure publish failed: %s", e)
+
+    # -- monitor loop ------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._publish_beat()
+            dead = self._check_peers()
+            if dead is not None:
+                local_rank, reason = dead
+                rank = self.global_ranks[local_rank]  # outward-facing
+                with self._mu:
+                    already = self._failed is not None
+                    if not already:
+                        self._failed = (rank, reason)
+                if not already:
+                    hvd_logging.error(
+                        "health watchdog: peer rank %d failed: %s",
+                        rank, reason)
+                    self.report_peer_failure(rank, reason)
+                    try:
+                        self.on_failure(rank, reason)
+                    except Exception:
+                        hvd_logging.exception(
+                            "health on_failure callback failed")
+                return  # one failure decision per watchdog lifetime
+            self._stop.wait(self.interval_s)
+
+    def _publish_beat(self) -> None:
+        self._beat += 1
+        try:
+            # One bounded retry ladder per beat: a transient KV flap must
+            # not look like OUR death to the peers.
+            _retry.call(
+                lambda: self.kv.put(self._beat_key(self.rank),
+                                    str(self._beat).encode()),
+                what="health.beat")
+            self._beats_sent += 1
+        except Exception as e:
+            self._beat_errors += 1
+            hvd_logging.warning("health: beat publish failed: %s", e)
+
+    def _fetch_beats(self) -> dict[int, int] | None:
+        """All beat counters keyed by local rank — ONE server-side gather
+        per tick when the KV supports it (our own beat satisfies the
+        count, so it never blocks), instead of one GET per peer per tick:
+        O(world) fleet-wide monitor load, not O(world^2). None on a
+        transport failure (the caller must not age peers on OUR error).
+        In-memory KVs (tests, the driver-side server) fall back to
+        direct gets — no HTTP involved there."""
+        prefix = f"{self.prefix}/beat"
+        gather = getattr(self.kv, "gather", None)
+        try:
+            if gather is not None:
+                got = gather(prefix, 1, timeout=max(self.interval_s, 0.2))
+            else:
+                got = {}
+                for r in list(self._seen):
+                    raw = self.kv.get(self._beat_key(r))
+                    if raw is not None:
+                        got[self._beat_key(r)] = raw
+        except TimeoutError:
+            return {}  # no beats under the prefix at all yet
+        except Exception:
+            return None
+        out: dict[int, int] = {}
+        for key, raw in got.items():
+            try:
+                out[int(key.rsplit("/", 1)[1])] = int(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+    def _check_poison(self):
+        """(local rank, reason) for the first poisoned peer, else None.
+        One key listing per tick; the reason payload is fetched only for
+        an actual hit."""
+        try:
+            names = self.kv.keys(f"{self.prefix}/poison")
+        except Exception:
+            return None  # KV flap: the beat timeout still guards
+        marker = f"{self.prefix}/poison/"
+        for key in sorted(names):
+            try:
+                r = int(key[len(marker):])
+            except ValueError:
+                continue
+            if r == self.rank or r not in self._seen:
+                continue
+            try:
+                reason = (self.kv.get(key) or b"").decode(errors="replace")
+            except Exception:
+                reason = "(reason unavailable)"
+            return r, f"poison record: {reason}"
+        return None
+
+    def _check_peers(self):
+        """Return ``(local rank, reason)`` for the first dead peer."""
+        now = time.monotonic()
+        dead = self._check_poison()
+        if dead is not None:
+            return dead
+        beats = self._fetch_beats()
+        if beats is None:
+            return None
+        for r in sorted(self._seen):
+            value = beats.get(r)
+            with self._mu:
+                last_value, changed_at = self._seen[r]
+                if value is not None and value != last_value:
+                    self._seen[r] = (value, now)
+                    continue
+                if changed_at is None:
+                    continue  # never beaten: startup grace (see __init__)
+                silent_s = now - changed_at
+            if silent_s > self.timeout_s:
+                return r, (f"no liveness beat for {silent_s:.1f}s "
+                           f"(HVD_HEALTH_TIMEOUT={self.timeout_s:g}s)")
+        return None
+
+    # -- introspection -----------------------------------------------------
+
+    def last_seen(self) -> dict[int, float | None]:
+        """Seconds since each peer's beat counter last advanced, keyed by
+        GLOBAL rank; None for a peer never seen beating."""
+        now = time.monotonic()
+        with self._mu:
+            return {self.global_ranks[r]:
+                    (None if changed_at is None else now - changed_at)
+                    for r, (_v, changed_at) in sorted(self._seen.items())}
+
+    def describe_peers(self) -> str:
+        """Human-readable liveness summary for error messages (the
+        exchange-timeout satellite: name the ranks last seen)."""
+        seen = self.last_seen()
+        if not seen:
+            return "no peers tracked"
+        return ", ".join(
+            (f"rank {r}: beat {s:.1f}s ago" if s is not None
+             else f"rank {r}: no beat observed yet")
+            for r, s in seen.items())
+
+    def stats(self) -> dict:
+        with self._mu:
+            failed = self._failed
+        return {
+            "rank": self.global_ranks[self.rank],
+            "world_size": self.world_size,
+            "member_ranks": list(self.global_ranks),
+            "interval_s": self.interval_s,
+            "timeout_s": self.timeout_s,
+            "beats_sent": self._beats_sent,
+            "beat_errors": self._beat_errors,
+            "peers_last_seen_s": self.last_seen(),
+            "failed_peer": (None if failed is None
+                            else {"rank": failed[0], "reason": failed[1]}),
+        }
+
+
+def make_peer_failure_error(dead_rank: int, reason: str,
+                            owed_tensors=()) -> PeerFailureError:
+    """The coordinated-abort error every waiter surfaces."""
+    return PeerFailureError(dead_rank, reason, owed_tensors)
+
+
+# -- process-wide registry + the hvd.health_stats() surface -----------------
+
+_registry_mu = threading.Lock()
+_watchdogs: list[HealthWatchdog] = []
+
+
+def _register(w: HealthWatchdog) -> None:
+    with _registry_mu:
+        if w not in _watchdogs:
+            _watchdogs.append(w)
+
+
+def _unregister(w: HealthWatchdog) -> None:
+    with _registry_mu:
+        if w in _watchdogs:
+            _watchdogs.remove(w)
+
+
+def health_stats() -> dict:
+    """Failure-domain counters (exported as ``hvd.health_stats()``):
+    per-site retry/giveup counts, fault-injection rule counters, and
+    every active watchdog's liveness view."""
+    with _registry_mu:
+        dogs = list(_watchdogs)
+    return {
+        "retries": _retry.stats(),
+        "faults": _faults.stats(),
+        "watchdogs": [w.stats() for w in dogs],
+    }

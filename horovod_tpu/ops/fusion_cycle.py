@@ -99,6 +99,7 @@ import numpy as np
 from .. import autotune as _autotune
 from .. import timeline as _timeline
 from ..utils import envs
+from ..utils import faults as _faults
 from ..utils import invariants as _inv
 from ..utils import logging as hvd_logging
 
@@ -565,6 +566,12 @@ class FusionScheduler:
     def _execute_inner(self, spec: _QueueSpec, entries: list[_Entry],
                        ticket=None) -> None:
         try:
+            # Chaos seam for the flush pipeline: an injected error here
+            # exercises the _fail_entries path (entries marked failed,
+            # waiters unblocked, handles raise at synchronize) exactly
+            # like a real dispatch failure. No-op with HVD_FAULT_SPEC
+            # unset (cached-bool fast path in utils/faults.py).
+            _faults.inject("exec.dispatch")
             if spec.kind == "sparse":
                 units = [[e] for e in entries]
                 self._dispatch_units(units, self._run_opaque_unit)
@@ -784,7 +791,7 @@ class FusionScheduler:
             if b.ticket is not None:
                 try:
                     b.spec.svc.negotiate_many_cancel(b.ticket)
-                except Exception:
+                except Exception:  # hvdlint: disable=silent-except
                     pass  # service may already be gone
             for e in b.entries:
                 if not e.done:

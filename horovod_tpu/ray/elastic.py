@@ -110,8 +110,8 @@ class _ActorProcess:
             self._code = 143
         try:
             self._ray.kill(self._actor)
-        except Exception:
-            pass
+        except Exception:  # hvdlint: disable=silent-except
+            pass  # actor already dead / cluster gone at terminate
 
 
 class _ElasticWorker:

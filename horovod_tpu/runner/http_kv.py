@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import hmac
+import http.client
 import http.server
 import json
 import os
@@ -23,8 +24,31 @@ import time
 import urllib.error
 import urllib.request
 
+from ..utils import faults as _faults
+from ..utils import logging as hvd_logging
+from ..utils import retry as _retry
+
 SECRET_ENV = "HVD_SECRET_KEY"
 _SIG_HEADER = "X-HVD-Signature"
+
+# HTTP statuses worth retrying: server-side wait expiry / throttling /
+# transient 5xx. 403 (bad signature) and 404 (missing key) are semantic.
+_TRANSIENT_HTTP = (408, 425, 429, 500, 502, 503, 504)
+
+
+def _transient_kv_error(exc: BaseException) -> bool:
+    """The retry predicate for every KV seam: connection-level failures
+    and transient HTTP statuses are retryable; semantic responses (404,
+    signature rejection) and programming errors are not. Injected
+    faults count as transient — the chaos contract is that KV flaps are
+    absorbed by the retry ladder."""
+    if isinstance(exc, _faults.FaultInjected):
+        return True
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code in _TRANSIENT_HTTP
+    return isinstance(exc, (urllib.error.URLError, ConnectionError,
+                            TimeoutError, socket.timeout,
+                            http.client.HTTPException))
 
 
 def make_secret() -> str:
@@ -142,8 +166,12 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         if observer is not None:
             try:
                 observer(key, payload)
-            except Exception:  # observer bugs must not break the store
-                pass
+            except Exception:
+                # Observer bugs must not break the store, but swallowing
+                # them silently hid real protocol failures (a driver that
+                # never learns a worker is ready looks like a hang).
+                hvd_logging.exception(
+                    "KV PUT observer failed for key %r", key)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -227,7 +255,13 @@ class KVClient:
 
     The default per-request timeout honors ``HVD_GLOO_TIMEOUT_SECONDS``
     (the reference's transport-op timeout knob, ``common.h:120``): raise
-    it on congested fabrics where a negotiation round can exceed 30 s."""
+    it on congested fabrics where a negotiation round can exceed 30 s.
+
+    Every verb retries transient transport failures through the unified
+    ``utils/retry.py`` ladder (``HVD_RETRY_*``; previously the first
+    connection reset raised straight into the caller), and carries a
+    fault-injection point named ``kv.<verb>`` — injected faults are
+    retried exactly like real flaps (docs/robustness.md)."""
 
     def __init__(self, addr: str, port: int, secret: str | None = None,
                  timeout: float | None = None):
@@ -237,48 +271,70 @@ class KVClient:
         self._timeout = timeout if timeout is not None else \
             envs.get_float(envs.GLOO_TIMEOUT_SECONDS, 30.0)
 
-    def _request(self, method: str, path: str, payload: bytes = b""):
+    def _request(self, method: str, path: str, payload: bytes = b"",
+                 timeout: float | None = None):
+        _faults.inject(f"kv.{method.lower()}")
         req = urllib.request.Request(
             f"{self._base}{path}", data=payload if method == "PUT" else None,
             method=method)
         if self._secret is not None:
             req.add_header(_SIG_HEADER,
                            _sign(self._secret, method, path, payload))
-        return urllib.request.urlopen(req, timeout=self._timeout)
+        # Per-request timeout override, never instance mutation: one
+        # client is shared between the engine cycle thread and the
+        # health watchdog, so there is no safe place to write _timeout.
+        return urllib.request.urlopen(
+            req, timeout=self._timeout if timeout is None else timeout)
+
+    def _read(self, method: str, path: str, payload: bytes = b"",
+              timeout: float | None = None) -> bytes:
+        # request AND body read inside one retry attempt: a connection
+        # dying mid-read must retry the whole exchange, not surface a
+        # short body
+        with self._request(method, path, payload, timeout=timeout) as resp:
+            return resp.read()
 
     def put(self, key: str, value: bytes) -> None:
-        with self._request("PUT", f"/{key}", value) as resp:
-            if resp.status != 200:
-                raise RuntimeError(f"KV put {key}: HTTP {resp.status}")
+        def attempt():
+            with self._request("PUT", f"/{key}", value) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"KV put {key}: HTTP {resp.status}")
+        _retry.call(attempt, what="kv.put", retry_on=_transient_kv_error)
 
     def get(self, key: str) -> bytes | None:
         try:
-            with self._request("GET", f"/{key}") as resp:
-                return resp.read()
+            return _retry.call(lambda: self._read("GET", f"/{key}"),
+                               what="kv.get", retry_on=_transient_kv_error)
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
             raise
 
     def keys(self, scope: str = "") -> list[str]:
-        with self._request("GET", f"/{scope.rstrip('/')}/") as resp:
-            return json.loads(resp.read())
+        return json.loads(_retry.call(
+            lambda: self._read("GET", f"/{scope.rstrip('/')}/"),
+            what="kv.keys", retry_on=_transient_kv_error))
 
     def delete(self, key: str) -> None:
-        with self._request("DELETE", f"/{key}"):
-            pass
+        _retry.call(lambda: self._read("DELETE", f"/{key}"),
+                    what="kv.delete", retry_on=_transient_kv_error)
 
     def wait(self, key: str, timeout: float = 60.0,
              poll_interval: float = 0.1) -> bytes:
-        """Block until ``key`` appears (rendezvous barrier primitive)."""
-        deadline = time.monotonic() + timeout
-        while True:
+        """Block until ``key`` appears (rendezvous barrier primitive).
+        Paced by the retry helper's jittered backoff (base
+        ``poll_interval`` growing toward 8x) instead of the old
+        fixed-interval busy-poll — long rendezvous waits back off the
+        server, and jitter decorrelates a fleet arriving at once."""
+        val = self.get(key)
+        if val is not None:
+            return val
+        for _ in _retry.poll_intervals("kv.wait", interval_s=poll_interval,
+                                       deadline_s=timeout):
             val = self.get(key)
             if val is not None:
                 return val
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"KV key {key!r} not set within {timeout}s")
-            time.sleep(poll_interval)
+        raise TimeoutError(f"KV key {key!r} not set within {timeout}s")
 
     def gather(self, scope: str, count: int, timeout: float = 60.0) -> dict:
         """Collect ``count`` keys under ``scope`` in one server-side
@@ -295,14 +351,19 @@ class KVClient:
             server_wait = max(min(remaining, 25.0), 0.05)
             path = (f"/__gather__/{scope.rstrip('/')}"
                     f"?count={count}&timeout={server_wait}")
+            def attempt():
+                return self._read("GET", path, timeout=server_wait + 10.0)
+
             try:
-                old = self._timeout
-                self._timeout = server_wait + 10.0
-                try:
-                    with self._request("GET", path) as resp:
-                        data = resp.read()
-                finally:
-                    self._timeout = old
+                # 408 is the long-poll's own "not yet" signal — the outer
+                # loop re-issues it immediately; everything else transient
+                # rides the backoff ladder within the remaining budget.
+                data = _retry.call(
+                    attempt, what="kv.gather",
+                    retry_on=lambda e: (_transient_kv_error(e) and not (
+                        isinstance(e, urllib.error.HTTPError)
+                        and e.code == 408)),
+                    deadline_s=remaining)
             except urllib.error.HTTPError as e:
                 if e.code == 408:  # server-side wait expired; retry
                     continue

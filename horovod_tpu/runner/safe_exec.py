@@ -52,7 +52,9 @@ def terminate_tree(pid: int) -> None:
             os.kill(pid, 0)
         except ProcessLookupError:
             return
-        time.sleep(0.1)
+        # kill-escalation probe, not an RPC retry: there is no server to
+        # back off from and the grace window is short and local
+        time.sleep(0.1)  # hvdlint: disable=silent-except
     _kill_tree(pid, signal.SIGKILL)
 
 

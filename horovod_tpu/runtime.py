@@ -292,8 +292,8 @@ def _kv_advertise_address() -> str:
     try:
         from jax._src import distributed as _dist
         coord = _dist.global_state.coordinator_address
-    except Exception:  # pragma: no cover - private API moved
-        pass
+    except Exception:  # pragma: no cover  # hvdlint: disable=silent-except
+        pass  # private API probe: absence falls through to the env knob
     if not coord:
         addr = envs.get(envs.COORDINATOR_ADDR)
         if addr:
@@ -379,8 +379,8 @@ def shutdown() -> None:
     if _bootstrap_kv_server is not None:
         try:
             _bootstrap_kv_server.stop()
-        except Exception:
-            pass
+        except Exception as e:
+            hvd_logging.debug("bootstrap KV server stop failed: %s", e)
         _bootstrap_kv_server = None
     if _bootstrap_seeded_env:
         # the seeded coordinates point at the server just stopped; a later

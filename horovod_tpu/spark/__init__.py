@@ -161,8 +161,9 @@ def run(fn: Callable, args=(), kwargs: dict | None = None,
             if _time.monotonic() > deadline:
                 try:
                     sc.cancelJobGroup(group)
-                except Exception:
-                    pass
+                except Exception:  # hvdlint: disable=silent-except
+                    pass  # best-effort cancel; the TimeoutError below is
+                    # the real signal
                 raise TimeoutError(
                     f"horovod_tpu.spark.run timed out after {start_timeout}s "
                     f"waiting for {num_proc} barrier tasks to start; check "

@@ -33,6 +33,8 @@ QUEUE_ENQUEUE = "QUEUE_ENQUEUE"
 CYCLE_FLUSH = "CYCLE_FLUSH"
 PIPELINE_LANE = "pipeline"
 INFLIGHT_DEPTH = "INFLIGHT_DEPTH"
+HEALTH_LANE = "health"
+RETRY = "RETRY"
 PHASE_BEGIN = 0
 PHASE_END = 1
 PHASE_INSTANT = 2
@@ -141,6 +143,23 @@ def record_inflight_depth(depth: int) -> None:
     if _active:
         record(PIPELINE_LANE, f"{INFLIGHT_DEPTH}.{int(depth)}",
                PHASE_INSTANT)
+
+
+def record_retry(what: str, attempt: int) -> None:
+    """Instant ``RETRY.<site>.<n>`` marker on the ``health`` lane when a
+    retried RPC/KV call backs off (``utils/retry.py``) — a flapping
+    transport shows as a burst of RETRY instants instead of silently
+    stretching the neighboring op ranges."""
+    if _active:
+        record(HEALTH_LANE, f"{RETRY}.{what}.{int(attempt)}", PHASE_INSTANT)
+
+
+def record_health_event(event: str) -> None:
+    """Instant marker on the ``health`` lane for watchdog state changes
+    (``PEER_DEAD.<rank>``, ``POISON``) so a coordinated abort is
+    attributable on the trace."""
+    if _active:
+        record(HEALTH_LANE, event, PHASE_INSTANT)
 
 
 def pipeline_stage(stage: str) -> "op_range":

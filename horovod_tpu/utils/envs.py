@@ -54,6 +54,13 @@ FLASH_ATTENTION = "FLASH_ATTENTION"  # opt into the Pallas flash kernel
 DEBUG_INVARIANTS = "DEBUG_INVARIANTS"  # dev-mode runtime invariant checker
 SPARK_START_TIMEOUT = "SPARK_START_TIMEOUT"  # spark barrier-task scheduling bound
 START_TIMEOUT = "START_TIMEOUT"  # programmatic run() worker startup bound
+FAULT_SPEC = "FAULT_SPEC"  # deterministic fault-injection spec (tests/chaos)
+HEALTH_INTERVAL = "HEALTH_INTERVAL"  # s between liveness beats (0 = watchdog off)
+HEALTH_TIMEOUT = "HEALTH_TIMEOUT"  # s without a peer beat before it is declared dead
+RETRY_MAX_ATTEMPTS = "RETRY_MAX_ATTEMPTS"  # attempts per retried RPC/KV call
+RETRY_BACKOFF_MS = "RETRY_BACKOFF_MS"  # initial backoff between attempts
+RETRY_MAX_BACKOFF_MS = "RETRY_MAX_BACKOFF_MS"  # backoff growth cap
+RETRY_JITTER = "RETRY_JITTER"  # +/- fraction of deterministic jitter on backoff
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -245,6 +252,27 @@ def pipeline_chunking_enabled() -> bool:
     program compositions."""
     return (pipeline_enabled() and pipeline_threshold_bytes() > 0
             and pipeline_chunks() >= 2)
+
+
+# Failure-domain defaults (docs/robustness.md). The health timeout must sit
+# far below the 600 s exchange deadline — a dead peer should surface as a
+# PeerFailureError in seconds, not after the full negotiation budget. The
+# retry ladder (50 ms * 2^k capped at 2 s, 5 attempts) absorbs single-digit
+# seconds of KV/coordinator flap without masking a real outage.
+DEFAULT_HEALTH_INTERVAL_S = 2.0
+DEFAULT_HEALTH_TIMEOUT_S = 30.0
+DEFAULT_RETRY_MAX_ATTEMPTS = 5
+DEFAULT_RETRY_BACKOFF_MS = 50.0
+DEFAULT_RETRY_MAX_BACKOFF_MS = 2000.0
+DEFAULT_RETRY_JITTER = 0.25
+
+
+def health_interval_s() -> float:
+    return get_float(HEALTH_INTERVAL, DEFAULT_HEALTH_INTERVAL_S)
+
+
+def health_timeout_s() -> float:
+    return get_float(HEALTH_TIMEOUT, DEFAULT_HEALTH_TIMEOUT_S)
 
 
 def donation_effective(platform: str) -> bool:

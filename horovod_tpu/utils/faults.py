@@ -1,0 +1,263 @@
+"""Deterministic, spec-driven fault injection (``HVD_FAULT_SPEC``).
+
+The failure paths grown across PRs 1-4 — pipelined flush executor,
+negotiation service, KV transport, elastic rounds — were essentially
+untestable because nothing in the tree could *produce* a failure on
+demand. This module is the chaos half of the failure domain
+(docs/robustness.md): named injection points threaded through the seams
+the runtime already owns fire **deterministically** from a seeded spec,
+so a chaos test reproduces the exact same fault sequence on every run.
+
+Spec grammar (semicolon-separated rules)::
+
+    HVD_FAULT_SPEC = "site:action[:key=value]..."  [";" more rules]
+
+    kv.put:error:p=0.2:seed=7        # 20% of KV PUTs raise FaultInjected
+    svc.exchange:delay=0.5:after=3   # negotiation rounds 4+ sleep 0.5 s
+    worker:crash:rank=1:at_step=5    # rank 1 hard-exits at commit #5
+
+* **site** — injection-point name (table in docs/robustness.md); a
+  trailing ``*`` prefix-matches (``kv.*`` covers put/get/delete).
+* **action** — ``error`` (raise :class:`FaultInjected`), ``crash``
+  (``os._exit``; code via ``code=N``, default 1), or ``delay=<seconds>``
+  (sleep, then continue).
+* **filters** — ``p=<0..1>`` fire probability (deterministic, from
+  ``seed=``), ``after=N`` skip the first N matching calls, ``times=N``
+  fire at most N times, ``rank=R`` / ``at_step=S`` match the caller's
+  context (``rank`` falls back to the launcher-seeded ``HVD_RANK``).
+
+Determinism: the probability draw is **not** ``random`` — it hashes
+``(seed, site, call-index)`` through ``zlib.crc32``, so a fixed seed
+yields the identical fire pattern on every run and on every rank (and
+the module stays legal in timer-reachable code, where the hvdlint
+timer-purity pass bans randomness).
+
+Fast path: with ``HVD_FAULT_SPEC`` unset, :func:`inject` is one module
+attribute read and one ``is None`` check (the PR-4 ``invariants.py``
+cached-bool idiom) — the hooks cost nothing in production.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+from . import envs
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired at ``site`` (never raised in production:
+    only a parsed ``HVD_FAULT_SPEC`` can construct one)."""
+
+    def __init__(self, site: str, rule: str):
+        super().__init__(
+            f"injected fault at {site!r} (HVD_FAULT_SPEC rule {rule!r})")
+        self.site = site
+        self.rule = rule
+
+
+class FaultSpecError(ValueError):
+    """``HVD_FAULT_SPEC`` could not be parsed."""
+
+
+_ACTIONS = ("error", "crash", "delay")
+
+
+class _Rule:
+    __slots__ = ("site", "action", "delay_s", "exit_code", "p", "seed",
+                 "after", "times", "rank", "at_step", "text",
+                 "calls", "fires")
+
+    def __init__(self, text: str):
+        self.text = text
+        parts = text.split(":")
+        if len(parts) < 2:
+            raise FaultSpecError(
+                f"fault rule {text!r}: expected 'site:action[:key=value]...'")
+        self.site = parts[0].strip()
+        if not self.site:
+            raise FaultSpecError(f"fault rule {text!r}: empty site")
+        action = parts[1].strip()
+        self.delay_s = 0.0
+        if action.startswith("delay="):
+            self.action = "delay"
+            try:
+                self.delay_s = float(action[len("delay="):])
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault rule {text!r}: bad delay value "
+                    f"{action[len('delay='):]!r}")
+        elif action in ("error", "crash"):
+            self.action = action
+        else:
+            raise FaultSpecError(
+                f"fault rule {text!r}: unknown action {action!r} "
+                f"(expected one of {_ACTIONS}, delay as 'delay=<seconds>')")
+        self.exit_code = 1
+        self.p = 1.0
+        self.seed = 0
+        self.after = 0
+        self.times: int | None = None
+        self.rank: int | None = None
+        self.at_step: int | None = None
+        for param in parts[2:]:
+            key, sep, value = param.partition("=")
+            key = key.strip()
+            if not sep:
+                raise FaultSpecError(
+                    f"fault rule {text!r}: parameter {param!r} is not "
+                    "key=value")
+            try:
+                if key == "p":
+                    self.p = float(value)
+                elif key == "seed":
+                    self.seed = int(value)
+                elif key == "after":
+                    self.after = int(value)
+                elif key == "times":
+                    self.times = int(value)
+                elif key == "rank":
+                    self.rank = int(value)
+                elif key == "at_step":
+                    self.at_step = int(value)
+                elif key == "code":
+                    self.exit_code = int(value)
+                else:
+                    raise FaultSpecError(
+                        f"fault rule {text!r}: unknown parameter {key!r}")
+            except ValueError as e:
+                if isinstance(e, FaultSpecError):
+                    raise
+                raise FaultSpecError(
+                    f"fault rule {text!r}: bad value for {key!r}: {value!r}")
+        if not 0.0 <= self.p <= 1.0:
+            raise FaultSpecError(
+                f"fault rule {text!r}: p={self.p} outside [0, 1]")
+        self.calls = 0  # matching calls seen (drives `after` and the draw)
+        self.fires = 0
+
+    def matches_site(self, site: str) -> bool:
+        if self.site.endswith("*"):
+            return site.startswith(self.site[:-1])
+        return site == self.site
+
+    def _draw(self, call_index: int) -> float:
+        """Deterministic uniform in [0, 1): hash of (seed, site, index).
+        Reproducible across runs/ranks for a fixed seed, unlike
+        ``random`` (also banned in timer-reachable code)."""
+        h = zlib.crc32(f"{self.seed}:{self.site}:{call_index}".encode())
+        return (h & 0xFFFFFFFF) / float(1 << 32)
+
+    def should_fire(self, rank: int | None, step: int | None) -> bool:
+        """Advance this rule's call counter for a site match and decide.
+        Caller holds the spec lock."""
+        if self.rank is not None and (rank is None or rank != self.rank):
+            return False
+        if self.at_step is not None and (step is None
+                                         or step != self.at_step):
+            return False
+        self.calls += 1
+        if self.calls <= self.after:
+            return False
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.p < 1.0 and self._draw(self.calls) >= self.p:
+            return False
+        self.fires += 1
+        return True
+
+
+class _Spec:
+    __slots__ = ("rules", "mu", "default_rank")
+
+    def __init__(self, text: str):
+        self.rules = [_Rule(part.strip())
+                      for part in text.split(";") if part.strip()]
+        if not self.rules:
+            raise FaultSpecError(
+                f"HVD_FAULT_SPEC {text!r} contains no rules")
+        # Injection points that don't know their rank (KV client, engine
+        # transport) match `rank=` rules against the launcher-seeded rank.
+        self.default_rank = envs.get_int(envs.RANK, -1)
+        if self.default_rank < 0:
+            self.default_rank = None
+        self.mu = threading.Lock()
+
+
+def parse_spec(text: str) -> list[_Rule]:
+    """Parse a spec string into rules (raises :class:`FaultSpecError`);
+    exposed for tests and the docs' grammar examples."""
+    return _Spec(text).rules
+
+
+# The cached spec. None == injection off == the production fast path:
+# inject() is one attribute read and one `is None` check.
+_SPEC: _Spec | None = None
+
+
+def _load() -> _Spec | None:
+    text = envs.get(envs.FAULT_SPEC)
+    return _Spec(text) if text else None
+
+
+_SPEC = _load()
+
+
+def active() -> bool:
+    """Whether any fault rule is installed (cached; see :func:`refresh`)."""
+    return _SPEC is not None
+
+
+def refresh() -> None:
+    """Re-read ``HVD_FAULT_SPEC`` (tests toggle it after import). A bad
+    spec raises :class:`FaultSpecError` and leaves injection off —
+    a typo must fail the chaos run, not silently disable it."""
+    global _SPEC
+    _SPEC = None
+    _SPEC = _load()
+
+
+def stats() -> dict:
+    """Per-rule call/fire counters, keyed by rule text (chaos tests
+    assert on these; surfaced through ``hvd.health_stats()``)."""
+    spec = _SPEC
+    if spec is None:
+        return {}
+    with spec.mu:
+        return {r.text: {"site": r.site, "calls": r.calls, "fires": r.fires}
+                for r in spec.rules}
+
+
+def _crash(code: int) -> None:  # monkeypatched by tests
+    import os
+    os._exit(code)
+
+
+def inject(site: str, *, rank: int | None = None,
+           step: int | None = None) -> None:
+    """The injection seam: no-op unless a spec rule matches ``site`` (and
+    its rank/step/after/times/p filters) — then sleep, raise, or exit
+    per the rule's action. ``rank``/``step`` are optional caller context;
+    rank falls back to the launcher-seeded process rank."""
+    spec = _SPEC
+    if spec is None:
+        return
+    fired = None
+    with spec.mu:
+        for rule in spec.rules:
+            if not rule.matches_site(site):
+                continue
+            if rule.should_fire(
+                    rank if rank is not None else spec.default_rank, step):
+                fired = rule
+                break
+    if fired is None:
+        return
+    if fired.action == "delay":
+        time.sleep(fired.delay_s)
+        return
+    if fired.action == "crash":
+        _crash(fired.exit_code)
+        return
+    raise FaultInjected(site, fired.text)

@@ -1,0 +1,149 @@
+"""Unified retry/backoff policy for every RPC/KV seam (``HVD_RETRY_*``).
+
+Before this module each seam invented its own failure posture:
+``KVClient.put`` raised on the first transient socket error, ``wait``
+busy-polled at a fixed interval, the elastic round wait slept a flat
+250 ms. This is the one place that posture lives now
+(docs/robustness.md): bounded exponential backoff with **deterministic
+jitter** and an optional deadline, adopted by KV put/get/wait/gather,
+rendezvous publication, and negotiation submission.
+
+Knobs (registered in ``utils/envs.py``, rows in docs/knobs.md):
+
+* ``HVD_RETRY_MAX_ATTEMPTS`` (5) — attempts per :func:`call`;
+* ``HVD_RETRY_BACKOFF_MS`` (50) — backoff before the first retry;
+* ``HVD_RETRY_MAX_BACKOFF_MS`` (2000) — backoff growth cap (doubling);
+* ``HVD_RETRY_JITTER`` (0.25) — backoff is scaled by a deterministic
+  factor in ``[1-j, 1+j]`` derived from ``zlib.crc32(what, attempt)``:
+  decorrelated across call sites, identical across runs (and free of
+  ``random``, which hvdlint's timer-purity pass bans in timer-reachable
+  code).
+
+Every retry bumps a per-site counter (surfaced through
+``hvd.health_stats()``) and drops a ``RETRY`` instant on the timeline,
+so a flapping transport is visible instead of silently absorbed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+from . import envs
+from . import logging as hvd_logging
+
+_mu = threading.Lock()
+_counters: dict[str, dict[str, int]] = {}
+
+
+def _note(what: str, kind: str) -> None:
+    with _mu:
+        c = _counters.setdefault(what, {"retries": 0, "giveups": 0})
+        c[kind] += 1
+
+
+def stats() -> dict:
+    """Per-site ``{"retries": n, "giveups": n}`` counters
+    (``hvd.health_stats()["retries"]``)."""
+    with _mu:
+        return {k: dict(v) for k, v in _counters.items()}
+
+
+def reset_stats() -> None:
+    with _mu:
+        _counters.clear()
+
+
+def _jitter_factor(what: str, attempt: int) -> float:
+    """Deterministic factor in [1-j, 1+j]: same schedule every run, but
+    two sites retrying in lockstep don't thunder in phase."""
+    j = envs.get_float(envs.RETRY_JITTER, envs.DEFAULT_RETRY_JITTER)
+    if j <= 0.0:
+        return 1.0
+    h = zlib.crc32(f"{what}:{attempt}".encode()) & 0xFFFFFFFF
+    return 1.0 + j * (2.0 * (h / float(1 << 32)) - 1.0)
+
+
+def backoff_s(what: str, attempt: int) -> float:
+    """The sleep before retry ``attempt`` (1-based): jittered
+    ``BACKOFF_MS * 2^(attempt-1)`` capped at ``MAX_BACKOFF_MS``."""
+    base = envs.get_float(envs.RETRY_BACKOFF_MS,
+                          envs.DEFAULT_RETRY_BACKOFF_MS) / 1e3
+    cap = envs.get_float(envs.RETRY_MAX_BACKOFF_MS,
+                         envs.DEFAULT_RETRY_MAX_BACKOFF_MS) / 1e3
+    raw = min(base * (2.0 ** (attempt - 1)), cap)
+    return raw * _jitter_factor(what, attempt)
+
+
+def max_attempts() -> int:
+    return max(envs.get_int(envs.RETRY_MAX_ATTEMPTS,
+                            envs.DEFAULT_RETRY_MAX_ATTEMPTS), 1)
+
+
+def _record_retry(what: str, attempt: int, exc: BaseException | None) -> None:
+    _note(what, "retries")
+    from .. import timeline as _timeline
+    _timeline.record_retry(what, attempt)
+    hvd_logging.debug("retry %d of %s: %s", attempt, what, exc)
+
+
+def call(fn, *, what: str, retry_on=None, attempts: int | None = None,
+         deadline_s: float | None = None):
+    """Run ``fn()`` with bounded exponential backoff.
+
+    ``retry_on`` decides retryability: a predicate ``exc -> bool``, a
+    tuple of exception types, or None (any ``Exception``). The last
+    failure re-raises unchanged once ``attempts`` (default
+    ``HVD_RETRY_MAX_ATTEMPTS``) are exhausted or ``deadline_s`` (a
+    budget from the first call, not per attempt) would be exceeded by
+    the next backoff."""
+    n = attempts if attempts is not None else max_attempts()
+    end = None if deadline_s is None else time.monotonic() + deadline_s
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except BaseException as exc:
+            if callable(retry_on):
+                retryable = retry_on(exc)
+            elif retry_on is not None:
+                retryable = isinstance(exc, retry_on)
+            else:
+                retryable = isinstance(exc, Exception)
+            delay = backoff_s(what, attempt)
+            if (not retryable or attempt >= n
+                    or (end is not None
+                        and time.monotonic() + delay > end)):
+                if retryable:
+                    _note(what, "giveups")
+                raise
+            _record_retry(what, attempt, exc)
+            time.sleep(delay)
+
+
+def poll_intervals(what: str, *, interval_s: float,
+                   deadline_s: float | None = None,
+                   max_interval_s: float | None = None):
+    """Jittered poll pacing for wait loops (KV ``wait``, the elastic
+    round wait): yields after sleeping each interval, stops once
+    ``deadline_s`` is exhausted (the caller raises its own timeout).
+    The interval backs off by 1.5x per yield up to ``max_interval_s``
+    (default 8x the base) — a long wait shouldn't keep hammering the
+    server at the initial rate."""
+    end = None if deadline_s is None else time.monotonic() + deadline_s
+    cap = max_interval_s if max_interval_s is not None else 8.0 * interval_s
+    cur = interval_s
+    attempt = 0
+    while True:
+        attempt += 1
+        delay = cur * _jitter_factor(what, attempt)
+        if end is not None:
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return
+            delay = min(delay, remaining)
+        time.sleep(delay)
+        yield attempt
+        cur = min(cur * 1.5, cap)

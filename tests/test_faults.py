@@ -1,0 +1,667 @@
+"""Failure-domain suite: deterministic fault injection, the unified
+retry ladder, the health watchdog, and the chaos scenarios from
+docs/robustness.md — KV flaps absorbed by retries, a dead rank surfacing
+as ``PeerFailureError`` on the survivors well under the exchange
+deadline with no hung waiter, and the elastic driver re-forming a round
+on spawn failures and watchdog peer-failure reports."""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import _native, health
+from horovod_tpu.exceptions import HorovodInternalError, PeerFailureError
+from horovod_tpu.runner.http_kv import KVClient, KVServer
+from horovod_tpu.utils import faults, retry
+
+
+@pytest.fixture()
+def fault_spec(monkeypatch):
+    """Install a fault spec for the duration of one test."""
+    def install(spec: str) -> None:
+        monkeypatch.setenv("HVD_FAULT_SPEC", spec)
+        faults.refresh()
+    yield install
+    monkeypatch.delenv("HVD_FAULT_SPEC", raising=False)
+    faults.refresh()
+
+
+@pytest.fixture()
+def kv_server():
+    server = KVServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+class TestSpecParsing:
+    def test_grammar_example_from_docs(self):
+        rules = faults.parse_spec(
+            "kv.put:error:p=0.2:seed=7;"
+            "svc.exchange:delay=0.5:after=3;"
+            "worker:crash:rank=1:at_step=5")
+        assert [r.site for r in rules] == ["kv.put", "svc.exchange",
+                                           "worker"]
+        assert rules[0].action == "error"
+        assert rules[0].p == 0.2 and rules[0].seed == 7
+        assert rules[1].action == "delay" and rules[1].delay_s == 0.5
+        assert rules[1].after == 3
+        assert rules[2].action == "crash"
+        assert rules[2].rank == 1 and rules[2].at_step == 5
+
+    def test_prefix_site_match(self):
+        (rule,) = faults.parse_spec("kv.*:error")
+        assert rule.matches_site("kv.put")
+        assert rule.matches_site("kv.get")
+        assert not rule.matches_site("svc.exchange")
+
+    @pytest.mark.parametrize("bad", [
+        "kv.put",                      # no action
+        "kv.put:explode",              # unknown action
+        ":error",                      # empty site
+        "kv.put:error:p=2.0",          # p out of range
+        "kv.put:error:tries=3",        # unknown parameter
+        "kv.put:error:after=soon",     # non-integer value
+        ";;",                          # no rules at all
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+    def test_refresh_rejects_bad_spec_and_disables(self, monkeypatch):
+        monkeypatch.setenv("HVD_FAULT_SPEC", "kv.put:bogus")
+        with pytest.raises(faults.FaultSpecError):
+            faults.refresh()
+        assert not faults.active()
+        monkeypatch.delenv("HVD_FAULT_SPEC")
+        faults.refresh()
+
+
+# ---------------------------------------------------------------------------
+# injection semantics
+# ---------------------------------------------------------------------------
+
+class TestInjection:
+    def test_noop_fast_path_when_unset(self):
+        assert not faults.active()
+        assert faults._SPEC is None  # inject() is one None check
+        faults.inject("kv.put")  # must be a no-op, not a lookup miss
+        assert faults.stats() == {}
+
+    def test_error_action_raises(self, fault_spec):
+        fault_spec("kv.put:error")
+        with pytest.raises(faults.FaultInjected) as exc:
+            faults.inject("kv.put")
+        assert exc.value.site == "kv.put"
+        faults.inject("kv.get")  # other sites untouched
+
+    def test_probability_is_deterministic_under_a_seed(self, fault_spec):
+        def pattern(spec):
+            fault_spec(spec)
+            fired = []
+            for i in range(200):
+                try:
+                    faults.inject("kv.put")
+                    fired.append(0)
+                except faults.FaultInjected:
+                    fired.append(1)
+            return fired
+
+        a = pattern("kv.put:error:p=0.3:seed=11")
+        b = pattern("kv.put:error:p=0.3:seed=11")
+        c = pattern("kv.put:error:p=0.3:seed=12")
+        assert a == b  # same seed: identical fire pattern
+        assert a != c  # different seed: different pattern
+        assert 20 < sum(a) < 110  # roughly p=0.3 over 200 draws
+
+    def test_after_and_times_filters(self, fault_spec):
+        fault_spec("s:error:after=2:times=1")
+        faults.inject("s")  # call 1: skipped
+        faults.inject("s")  # call 2: skipped
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("s")  # call 3: fires
+        faults.inject("s")  # times=1 exhausted
+        st = faults.stats()["s:error:after=2:times=1"]
+        assert st["calls"] == 4 and st["fires"] == 1
+
+    def test_rank_and_step_filters(self, fault_spec):
+        fault_spec("worker:error:rank=1:at_step=3")
+        faults.inject("worker", rank=0, step=3)   # wrong rank
+        faults.inject("worker", rank=1, step=2)   # wrong step
+        faults.inject("worker", rank=1)           # no step context
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("worker", rank=1, step=3)
+
+    def test_delay_action_sleeps(self, fault_spec):
+        fault_spec("slow:delay=0.2")
+        t0 = time.monotonic()
+        faults.inject("slow")
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_crash_action_exits(self, fault_spec, monkeypatch):
+        codes = []
+        monkeypatch.setattr(faults, "_crash", codes.append)
+        fault_spec("worker:crash:code=7")
+        faults.inject("worker")
+        assert codes == [7]
+
+
+# ---------------------------------------------------------------------------
+# retry ladder
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_backoff_schedule_is_deterministic_and_bounded(self):
+        a = [retry.backoff_s("site", k) for k in range(1, 8)]
+        b = [retry.backoff_s("site", k) for k in range(1, 8)]
+        assert a == b
+        # jittered 50ms * 2^(k-1) capped at 2 s, jitter within +/-25%
+        for k, delay in enumerate(a, start=1):
+            raw = min(0.05 * 2 ** (k - 1), 2.0)
+            assert raw * 0.75 <= delay <= raw * 1.25
+        # different sites de-correlate
+        assert retry.backoff_s("other", 1) != retry.backoff_s("site", 1)
+
+    def test_call_retries_then_succeeds_and_counts(self, monkeypatch):
+        monkeypatch.setenv("HVD_RETRY_BACKOFF_MS", "1")
+        retry.reset_stats()
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionError("flap")
+            return "ok"
+
+        assert retry.call(flaky, what="t.flaky",
+                          retry_on=(ConnectionError,)) == "ok"
+        assert len(attempts) == 3
+        assert retry.stats()["t.flaky"]["retries"] == 2
+
+    def test_non_retryable_raises_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("semantic")
+
+        with pytest.raises(ValueError):
+            retry.call(bad, what="t.bad", retry_on=(ConnectionError,))
+        assert len(calls) == 1
+
+    def test_predicate_retry_on_and_giveup_counter(self, monkeypatch):
+        monkeypatch.setenv("HVD_RETRY_BACKOFF_MS", "1")
+        monkeypatch.setenv("HVD_RETRY_MAX_ATTEMPTS", "3")
+        retry.reset_stats()
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            retry.call(always, what="t.down",
+                       retry_on=lambda e: isinstance(e, ConnectionError))
+        assert len(calls) == 3
+        assert retry.stats()["t.down"]["giveups"] == 1
+
+    def test_deadline_bounds_total_attempts(self, monkeypatch):
+        monkeypatch.setenv("HVD_RETRY_BACKOFF_MS", "200")
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            retry.call(always, what="t.deadline", attempts=100,
+                       retry_on=(ConnectionError,), deadline_s=0.3)
+        assert time.monotonic() - t0 < 2.0
+        assert len(calls) < 10
+
+    def test_poll_intervals_respects_deadline(self):
+        t0 = time.monotonic()
+        ticks = sum(1 for _ in retry.poll_intervals(
+            "t.poll", interval_s=0.05, deadline_s=0.3))
+        elapsed = time.monotonic() - t0
+        assert ticks >= 2
+        assert 0.2 <= elapsed <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# KV chaos: flaps absorbed by the retry ladder
+# ---------------------------------------------------------------------------
+
+class TestKVChaos:
+    def test_put_get_absorb_injected_flaps(self, kv_server, fault_spec,
+                                           monkeypatch):
+        monkeypatch.setenv("HVD_RETRY_BACKOFF_MS", "1")
+        retry.reset_stats()
+        fault_spec("kv.put:error:p=0.5:seed=3;kv.get:error:p=0.5:seed=4")
+        client = KVClient("127.0.0.1", kv_server.port)
+        for i in range(20):
+            client.put(f"chaos/{i}", str(i).encode())
+        for i in range(20):
+            assert client.get(f"chaos/{i}") == str(i).encode()
+        st = retry.stats()
+        assert st.get("kv.put", {}).get("retries", 0) > 0
+        assert st.get("kv.get", {}).get("retries", 0) > 0
+        fires = sum(r["fires"] for r in faults.stats().values())
+        assert fires > 0  # the flaps actually happened
+
+    def test_wait_survives_flaps_and_returns(self, kv_server, fault_spec,
+                                             monkeypatch):
+        monkeypatch.setenv("HVD_RETRY_BACKOFF_MS", "1")
+        fault_spec("kv.get:error:p=0.3:seed=9")
+        client = KVClient("127.0.0.1", kv_server.port)
+
+        def late_put():
+            time.sleep(0.3)
+            kv_server.put("late/key", b"v")
+
+        t = threading.Thread(target=late_put)
+        t.start()
+        assert client.wait("late/key", timeout=10.0,
+                           poll_interval=0.05) == b"v"
+        t.join()
+
+    def test_gather_survives_flaps(self, kv_server, fault_spec, monkeypatch):
+        monkeypatch.setenv("HVD_RETRY_BACKOFF_MS", "1")
+        fault_spec("kv.get:error:p=0.3:seed=5")
+        client = KVClient("127.0.0.1", kv_server.port)
+        for r in range(3):
+            kv_server.put(f"g/{r}", str(r).encode())
+        got = client.gather("g", 3, timeout=10.0)
+        assert got == {f"g/{r}": str(r).encode() for r in range(3)}
+
+    def test_semantic_404_is_not_retried(self, kv_server):
+        retry.reset_stats()
+        client = KVClient("127.0.0.1", kv_server.port)
+        assert client.get("absent/key") is None
+        assert retry.stats().get("kv.get", {}).get("retries", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# health watchdog
+# ---------------------------------------------------------------------------
+
+class TestHealthWatchdog:
+    def _watchdog(self, kv, rank, on_failure, world=2, interval=0.1,
+                  timeout=0.6):
+        return health.HealthWatchdog(
+            kv, world, rank, prefix="t/health", on_failure=on_failure,
+            interval_s=interval, timeout_s=timeout)
+
+    def test_beating_peers_stay_alive(self, kv_server):
+        failures = []
+        a = self._watchdog(kv_server, 0, lambda r, why: failures.append(r))
+        b = self._watchdog(kv_server, 1, lambda r, why: failures.append(r))
+        a.start()
+        b.start()
+        try:
+            time.sleep(1.0)  # > timeout: both keep beating, nobody dies
+            assert failures == []
+            assert a.stats()["beats_sent"] >= 3
+            assert a.last_seen()[1] < 0.6
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_silent_peer_declared_dead_within_budget(self, kv_server):
+        failures = []
+        done = threading.Event()
+
+        def on_failure(rank, reason):
+            failures.append((rank, reason))
+            done.set()
+
+        # rank 1 beats, then dies: its counter stops advancing
+        a = self._watchdog(kv_server, 0, on_failure)
+        b = self._watchdog(kv_server, 1, lambda r, w: None)
+        a.start()
+        b.start()
+        try:
+            time.sleep(0.3)  # let a observe b alive
+            t0 = time.monotonic()
+            b.stop()  # beats cease
+            assert done.wait(5.0), "watchdog never declared the dead peer"
+            elapsed = time.monotonic() - t0
+            rank, reason = failures[0]
+            assert rank == 1
+            assert "no liveness beat" in reason
+            # < timeout + a couple of beat intervals, NOT the 600 s
+            # exchange deadline
+            assert elapsed < 0.6 + 5 * 0.1 + 1.0
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_never_beaten_peer_gets_startup_grace(self, kv_server):
+        # Service creation is lazy (first collective), so a peer that
+        # hasn't STARTED yet must not be declared dead — silence
+        # detection arms only after its first beat.
+        failures = []
+        a = self._watchdog(kv_server, 0, lambda r, w: failures.append(r))
+        a.start()
+        try:
+            time.sleep(1.2)  # well past timeout=0.6
+            assert failures == []
+            assert a.last_seen()[1] is None  # tracked, never seen
+            assert "no beat observed yet" in a.describe_peers()
+        finally:
+            a.stop()
+
+    def test_subset_watchdog_reports_global_ranks(self, kv_server):
+        # A per-process-set service runs on set-local indices; failures
+        # must surface as GLOBAL ranks or the driver blacklists the
+        # wrong host.
+        failures = []
+        done = threading.Event()
+
+        def on_failure(rank, reason):
+            failures.append(rank)
+            done.set()
+
+        a = health.HealthWatchdog(
+            kv_server, 2, 0, prefix="sub/health", on_failure=on_failure,
+            interval_s=0.1, timeout_s=0.5, global_ranks=[1, 3])
+        b = health.HealthWatchdog(
+            kv_server, 2, 1, prefix="sub/health", on_failure=lambda r, w: 0,
+            interval_s=0.1, timeout_s=0.5, global_ranks=[1, 3])
+        a.start()
+        b.start()
+        try:
+            time.sleep(0.3)
+            b.stop()
+            assert done.wait(5.0)
+            assert failures == [3]  # global rank, not set-local 1
+            assert 3 in a.last_seen()
+            assert a.stats()["rank"] == 1  # our own global rank
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_poison_record_fails_peers_fast(self, kv_server):
+        failures = []
+        done = threading.Event()
+
+        def on_failure(rank, reason):
+            failures.append((rank, reason))
+            done.set()
+
+        a = self._watchdog(kv_server, 0, on_failure, timeout=30.0)
+        b = self._watchdog(kv_server, 1, lambda r, w: None, timeout=30.0)
+        a.start()
+        b.start()
+        try:
+            time.sleep(0.3)
+            b.poison("simulated local engine failure")
+            # far below the 30 s beat timeout: poison is the fast path
+            assert done.wait(3.0)
+            rank, reason = failures[0]
+            assert rank == 1
+            assert "poison" in reason
+            assert "simulated local engine failure" in reason
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_describe_peers_and_stats_shape(self, kv_server):
+        a = self._watchdog(kv_server, 0, lambda r, w: None)
+        a.start()
+        try:
+            desc = a.describe_peers()
+            assert "rank 1" in desc
+            st = a.stats()
+            assert st["rank"] == 0 and st["world_size"] == 2
+            assert 1 in st["peers_last_seen_s"]
+            assert st["failed_peer"] is None
+            agg = health.health_stats()
+            assert any(w["rank"] == 0 for w in agg["watchdogs"])
+        finally:
+            a.stop()
+        assert all(w["rank"] != 0 or w["beats_sent"] == 0
+                   for w in health.health_stats()["watchdogs"]) or \
+            health.health_stats()["watchdogs"] == []
+
+    def test_peer_failure_error_type_and_payload(self):
+        exc = health.make_peer_failure_error(3, "no beat for 31.0s",
+                                             ("t1", "t2"))
+        assert isinstance(exc, PeerFailureError)
+        assert isinstance(exc, HorovodInternalError)  # elastic-recoverable
+        assert exc.rank == 3
+        assert exc.owed_tensors == ("t1", "t2")
+        assert "rank 3" in str(exc) and "t1" in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# chaos: rank death mid-negotiation -> PeerFailureError on the survivor
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not _native.available(),
+                    reason="native engine unavailable (no g++?)")
+class TestPeerFailureChaos:
+    def _make_services(self, kv_server, monkeypatch):
+        from horovod_tpu.dynamic import NativeEngine
+        from horovod_tpu.engine_service import DynamicService, KVTransport
+        # Fast watchdog + a small exchange deadline so the test proves
+        # failure detection beats the deadline by an order of magnitude.
+        monkeypatch.setenv("HVD_HEALTH_INTERVAL", "0.1")
+        monkeypatch.setenv("HVD_HEALTH_TIMEOUT", "0.8")
+        monkeypatch.setenv("HVD_ELASTIC_TIMEOUT", "30")
+        svcs = []
+        for rank in range(2):
+            kv = KVClient("127.0.0.1", kv_server.port)
+            transport = KVTransport(kv, 2, rank, prefix="chaos")
+            svcs.append(DynamicService(
+                NativeEngine(world_size=2, rank=rank), transport,
+                cycle_time_s=0.02))
+        return svcs
+
+    def test_rank_death_surfaces_fast_with_no_hung_waiter(self, kv_server,
+                                                          monkeypatch):
+        from horovod_tpu.dynamic import REQ_ALLREDUCE
+        svc0, svc1 = self._make_services(kv_server, monkeypatch)
+        assert svc0.health_watchdog() is not None
+        try:
+            # a warm negotiation proves the pair works
+            results = [None, None]
+
+            def negotiate(svc, slot):
+                try:
+                    results[slot] = svc.negotiate("warm", REQ_ALLREDUCE,
+                                                  shape=(4,))
+                except Exception as e:  # captured for the assert
+                    results[slot] = e
+
+            threads = [threading.Thread(target=negotiate, args=(s, i))
+                       for i, s in enumerate((svc0, svc1))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert not any(t.is_alive() for t in threads)
+            assert not isinstance(results[0], Exception), results[0]
+
+            # rank 0 submits; rank 1 dies (service + watchdog stop: its
+            # beats cease mid-negotiation)
+            err = [None]
+            waited = threading.Event()
+
+            def blocked_negotiate():
+                try:
+                    svc0.negotiate("owed_tensor", REQ_ALLREDUCE, shape=(4,))
+                except Exception as e:
+                    err[0] = e
+                waited.set()
+
+            t0 = time.monotonic()
+            waiter = threading.Thread(target=blocked_negotiate)
+            waiter.start()
+            time.sleep(0.2)
+            svc1.stop()
+
+            assert waited.wait(10.0), "survivor's waiter hung"
+            elapsed = time.monotonic() - t0
+            waiter.join(timeout=5)
+            assert not waiter.is_alive()  # no leaked waiter thread
+            assert isinstance(err[0], PeerFailureError), err[0]
+            assert err[0].rank == 1
+            assert "owed_tensor" in str(err[0])
+            # detection ~ HVD_HEALTH_TIMEOUT + one interval, far under the
+            # 30 s exchange deadline (let alone the 600 s default)
+            assert elapsed < 5.0, elapsed
+
+            # the failed service refuses new work with the same error
+            with pytest.raises(PeerFailureError):
+                svc0.negotiate("post_mortem", REQ_ALLREDUCE, shape=(4,))
+
+            # the fusion scheduler was aborted: nothing pending, executor
+            # queue drained (coordinated abort step 3)
+            from horovod_tpu.ops import fusion_cycle
+            st = fusion_cycle.stats()
+            assert st["pending_tensors"] == 0
+            assert st["pipeline"]["queue_depth"] == 0
+        finally:
+            svc0.stop()
+            svc1.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: elastic driver re-forms the round on injected failures
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self):
+        self._exit = threading.Event()
+        self._code = None
+
+    def exit(self, code):
+        self._code = code
+        self._exit.set()
+
+    def wait(self, timeout=None):
+        self._exit.wait(timeout)
+        return self._code
+
+    def poll(self):
+        return self._code if self._exit.is_set() else None
+
+    def terminate(self):
+        if not self._exit.is_set():
+            self.exit(143)
+
+
+class _Harness:
+    def __init__(self, host_slots, min_np, max_np=None):
+        from horovod_tpu.elastic import (
+            ElasticDriver,
+            ElasticRendezvous,
+            FixedHosts,
+        )
+        self.kv = KVServer()
+        self.kv.start()
+        self.rendezvous = ElasticRendezvous(self.kv)
+        self.driver = ElasticDriver(self.rendezvous, FixedHosts(host_slots),
+                                    min_np, max_np, timeout=10)
+        self.procs = {}
+        self.lock = threading.Lock()
+
+    def create_worker(self, slot_info, spec_round):
+        proc = _FakeProc()
+        with self.lock:
+            self.procs.setdefault(
+                (slot_info.hostname, slot_info.local_rank), []).append(proc)
+        return proc
+
+    def wait_round(self, round_id, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.rendezvous.round_id >= round_id:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"round {round_id} never published "
+            f"(at {self.rendezvous.round_id})")
+
+    def stop(self):
+        self.driver.stop()
+        self.kv.stop()
+
+
+class TestElasticChaos:
+    def test_injected_spawn_failure_blacklists_and_reforms(self, fault_spec):
+        # rank 1 lands on host b (2 hosts x 1 slot); its spawn fails once
+        fault_spec("worker.launch:error:rank=1:times=1")
+        h = _Harness({"a": 1, "b": 1}, min_np=1, max_np=2)
+        try:
+            h.driver.start(2, h.create_worker)
+            # the failed spawn becomes a registry failure -> host b is
+            # blacklisted -> a new round forms with host a only, within
+            # one discovery cycle (1 s) plus scheduling slack
+            h.wait_round(2, timeout=10.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if (h.driver._host_manager.is_blacklisted("b")
+                        and h.driver.world_size() == 1):
+                    break
+                time.sleep(0.05)
+            assert h.driver._host_manager.is_blacklisted("b")
+            assert h.driver.world_size() == 1
+            assert not h.driver.finished()  # the job survived the fault
+        finally:
+            h.stop()
+
+    def test_watchdog_peer_report_blacklists_and_reforms(self):
+        # a surviving worker's watchdog reports rank 1 dead via the KV
+        # record; the driver converts it into a registry failure without
+        # waiting for the dead process to exit
+        h = _Harness({"a": 1, "b": 1}, min_np=1, max_np=2)
+        try:
+            h.driver.start(2, h.create_worker)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(h.procs) < 2:
+                time.sleep(0.05)
+            assert h.driver.world_size() == 2
+            dead_host = h.driver._rank_assignments[1].hostname
+            import json
+            h.kv.put(health.peer_failure_key(0), json.dumps(
+                {"dead_rank": 1, "reason": "no beat for 1.0s"}).encode())
+            # feed through the observer exactly as a worker PUT would
+            parsed = health.parse_peer_failure(
+                health.peer_failure_key(0),
+                h.kv.get(health.peer_failure_key(0)))
+            assert parsed == (1, "no beat for 1.0s")
+            h.driver.record_peer_failure(*parsed)
+            h.wait_round(2, timeout=10.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if h.driver._host_manager.is_blacklisted(dead_host):
+                    break
+                time.sleep(0.05)
+            assert h.driver._host_manager.is_blacklisted(dead_host)
+            assert not h.driver.finished()
+        finally:
+            h.stop()
+
+    def test_commit_site_crashes_at_the_configured_step(self, fault_spec,
+                                                        monkeypatch):
+        from horovod_tpu.elastic.state import ObjectState
+        codes = []
+        monkeypatch.setattr(faults, "_crash", codes.append)
+        fault_spec("worker:crash:rank=1:at_step=2")
+        state = ObjectState(lambda obj: obj, lambda: 1, epoch=0)
+        state.commit()   # step 1: survives
+        assert codes == []
+        state.commit()   # step 2: dies
+        assert codes == [1]
+        other = ObjectState(lambda obj: obj, lambda: 0, epoch=0)
+        other.commit()
+        other.commit()   # rank 0 never crashes
+        assert codes == [1]

@@ -441,6 +441,129 @@ class TestDonation:
 
 
 # ---------------------------------------------------------------------------
+# silent-except
+# ---------------------------------------------------------------------------
+
+class TestSilentExcept:
+    def test_trips_on_broad_silent_handlers(self, tmp_path):
+        src = """
+            def swallow():
+                try:
+                    risky()
+                except Exception:
+                    pass
+                try:
+                    risky()
+                except:
+                    pass
+                try:
+                    risky()
+                except (ValueError, BaseException):
+                    pass
+        """
+        found = findings_for(tmp_path, "silent-except", {"bad.py": src})
+        assert len(found) == 3
+        assert all("silent handler" in f.message for f in found)
+
+    def test_narrow_typed_pass_is_legal(self, tmp_path):
+        src = """
+            import queue
+
+            def drain(q):
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    q.close()
+                except (OSError, ValueError):
+                    pass
+        """
+        found = findings_for(tmp_path, "silent-except", {"ok.py": src})
+        assert found == []
+
+    def test_nonempty_handler_body_is_legal(self, tmp_path):
+        src = """
+            def logged(log):
+                try:
+                    risky()
+                except Exception:
+                    log.warning("risky failed")
+        """
+        found = findings_for(tmp_path, "silent-except", {"ok.py": src})
+        assert found == []
+
+    def test_pragma_suppresses_handler(self, tmp_path):
+        src = """
+            def vetted():
+                try:
+                    risky()
+                except Exception:  # hvdlint: disable=silent-except
+                    pass  # torn down at GC time; nothing can be done
+        """
+        found = findings_for(tmp_path, "silent-except", {"ok.py": src})
+        assert found == []
+
+    def test_trips_on_sleep_retry_loop(self, tmp_path):
+        src = """
+            import time
+
+            def poll(ready):
+                while not ready():
+                    time.sleep(0.1)
+        """
+        found = findings_for(tmp_path, "silent-except", {"bad.py": src})
+        assert len(found) == 1
+        assert "utils/retry.py" in found[0].message
+
+    def test_sleep_outside_loop_and_in_retry_home_are_legal(self, tmp_path):
+        loop_src = """
+            import time
+
+            def backoff_loop():
+                while True:
+                    time.sleep(0.1)
+        """
+        src = """
+            import time
+
+            def one_shot():
+                time.sleep(0.5)
+        """
+        found = findings_for(
+            tmp_path, "silent-except", {"ok.py": src},
+            extra={"utils/retry.py": loop_src})
+        assert found == []
+
+    def test_sleep_in_nested_def_inside_loop_is_that_funcs_business(
+            self, tmp_path):
+        src = """
+            import time
+
+            def build():
+                fns = []
+                for _ in range(3):
+                    def waiter():
+                        time.sleep(0.1)
+                    fns.append(waiter)
+                return fns
+        """
+        found = findings_for(tmp_path, "silent-except", {"ok.py": src})
+        assert found == []
+
+    def test_sleep_pragma_suppresses(self, tmp_path):
+        src = """
+            import time
+
+            def escalate(alive):
+                while alive():
+                    time.sleep(0.1)  # hvdlint: disable=silent-except
+        """
+        found = findings_for(tmp_path, "silent-except", {"ok.py": src})
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # the real tree + CLI contract
 # ---------------------------------------------------------------------------
 
@@ -480,4 +603,4 @@ class TestRepoGate:
     def test_every_pass_registered(self):
         from tools.hvdlint import PASSES
         assert list(PASSES) == ["issue-lock", "lock-order", "timer-purity",
-                                "knob-registry", "donation"]
+                                "knob-registry", "donation", "silent-except"]

@@ -1,6 +1,6 @@
 """hvdlint: project-invariant static analysis for the horovod_tpu runtime.
 
-Five AST passes, each encoding a concurrency/determinism invariant that a
+Six AST passes, each encoding a concurrency/determinism invariant that a
 PR introduced and a future regression would break silently (a hang or a
 cross-rank divergence, not a test failure):
 
@@ -19,6 +19,9 @@ knob-registry  every HVD_* knob flows through utils/envs.py and round-trips
                override-epoch invalidation)
 donation       a donated buffer is never referenced after the donating call
                (PR 1's aliasing rules; CPU tests cannot catch this)
+silent-except  broad ``except: pass`` handlers and hand-rolled
+               ``time.sleep`` retry loops route failures around the
+               failure domain (PR 5's retry/watchdog machinery)
 =============  ==============================================================
 
 Run ``python -m tools.hvdlint horovod_tpu`` from the repo root; findings

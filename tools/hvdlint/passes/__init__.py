@@ -2,7 +2,14 @@
 
 from __future__ import annotations
 
-from . import donation, issue_lock, knob_registry, lock_order, timer_purity
+from . import (
+    donation,
+    issue_lock,
+    knob_registry,
+    lock_order,
+    silent_except,
+    timer_purity,
+)
 
 # name -> run(project) -> list[Finding]; keep the catalog order stable so
 # output and docs line up.
@@ -12,4 +19,5 @@ PASSES = {
     timer_purity.NAME: timer_purity.run,
     knob_registry.NAME: knob_registry.run,
     donation.NAME: donation.run,
+    silent_except.NAME: silent_except.run,
 }

@@ -1,0 +1,103 @@
+"""silent-except pass: failures must be surfaced, retried, or vetted.
+
+Invariant (PR 5, docs/robustness.md): the failure domain only works if
+failures actually *reach* it. Two lexical patterns defeat that silently:
+
+1. **Swallowed broad exceptions** — an ``except``/``except Exception``/
+   ``except BaseException`` handler whose body is exactly ``pass``
+   discards errors the retry/watchdog/poison machinery should have seen
+   (the PUT-observer bug this PR fixed hid a dead elastic protocol as a
+   hang). Narrow typed handlers (``except queue.Empty: pass``,
+   ``except OSError: pass``) are deliberate control flow and stay legal;
+   a *broad* silent handler needs either a real body (log it) or a
+   ``# hvdlint: disable=silent-except`` pragma documenting why nothing
+   can be done.
+2. **Hand-rolled sleep loops** — ``time.sleep`` inside a ``while``/
+   ``for`` loop outside ``utils/retry.py`` is a fixed-cadence retry/poll
+   loop that bypasses the unified backoff policy (``HVD_RETRY_*``
+   knobs, deterministic jitter, deadline accounting, retry counters in
+   ``hvd.health_stats()``). Route it through ``retry.call`` /
+   ``retry.poll_intervals``, or pragma a vetted exception (e.g. the
+   SIGKILL escalation probe in ``runner/safe_exec.py``, which has no
+   server to back off from).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, dotted_name, parent_map
+
+NAME = "silent-except"
+
+_BROAD = ("Exception", "BaseException")
+_RETRY_HOME = "utils/retry.py"
+
+
+def _is_broad(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return True  # bare except
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _check_silent_handlers(sf, findings: list[Finding]) -> None:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        body_is_pass = (len(node.body) == 1
+                        and isinstance(node.body[0], ast.Pass))
+        if not body_is_pass or not _is_broad(node.type):
+            continue
+        if sf.suppressed(NAME, node.lineno) \
+                or sf.suppressed(NAME, node.body[0].lineno):
+            continue
+        what = ("bare except" if node.type is None
+                else f"except {ast.unparse(node.type)}")
+        findings.append(Finding(
+            NAME, sf.rel, node.lineno,
+            f"{what}: pass — a broad silent handler discards failures "
+            "the failure domain should see (retry ladder, watchdog "
+            "poison, health_stats counters). Log it, narrow the type, "
+            "or pragma a vetted best-effort site"))
+
+
+def _check_sleep_loops(sf, findings: list[Finding]) -> None:
+    parents = parent_map(sf.tree)
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) == "time.sleep"):
+            continue
+        # In a loop? Walk ancestors up to the enclosing function/module:
+        # a sleep in a nested def is that function's own business.
+        cur = parents.get(node)
+        in_loop = False
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.ClassDef, ast.Module)):
+            if isinstance(cur, (ast.While, ast.For)):
+                in_loop = True
+                break
+            cur = parents.get(cur)
+        if not in_loop or sf.suppressed(NAME, node.lineno):
+            continue
+        findings.append(Finding(
+            NAME, sf.rel, node.lineno,
+            "time.sleep inside a loop: a hand-rolled retry/poll loop "
+            "bypasses the unified backoff policy — use "
+            "utils/retry.py (retry.call / retry.poll_intervals) so "
+            "HVD_RETRY_* knobs, jitter, deadlines, and the "
+            "health_stats retry counters apply"))
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    retry_rel = f"{project.package_rel}/{_RETRY_HOME}"
+    for sf in project.files:
+        _check_silent_handlers(sf, findings)
+        if sf.rel != retry_rel:
+            _check_sleep_loops(sf, findings)
+    return findings
