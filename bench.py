@@ -209,6 +209,20 @@ def _median_ms(one_round, iters: int, divisor: int = 1) -> float:
     return float(np.median(times) * 1e3)
 
 
+def _pipeline_summary():
+    """Overlap figures persisted into EVERY bench payload (ISSUE 6
+    satellite): the perf trajectory must track whether communication is
+    actually hidden, not just wall time."""
+    import horovod_tpu as hvd
+    p = hvd.fusion_stats()["pipeline"]
+    return {
+        "overlap_ratio": round(p["overlap_ratio"], 3),
+        "inflight_peak": int(p["inflight_peak"]),
+        "slot_occupancy": round(p["slot_occupancy"], 3),
+        "device_wait_ms": round(p["device_wait_ms"], 3),
+    }
+
+
 def run_dispatch_bench(args) -> None:
     """Per-call eager dispatch overhead microbench (CPU backend, virtual
     8-chip mesh): repeated same-signature ``grouped_allreduce`` with the
@@ -260,6 +274,7 @@ def run_dispatch_bench(args) -> None:
         "cache_on": {"ms_per_call": round(on_ms, 4),
                      "stats": stats},
         "numerics_match": bool(numerics_match),
+        "pipeline_overlap": _pipeline_summary(),
         "baseline": "same-signature grouped_allreduce, plan cache disabled "
                     "via HVD_CACHE_CAPACITY=0 (the pre-cache dispatch path)",
         "config": {"op": "grouped_allreduce", "tensors": args.dispatch_tensors,
@@ -336,6 +351,7 @@ def run_cycle_bench(args) -> None:
                                  "flushes", "flushed_tensors", "dispatches",
                                  "tensors_per_flush", "coalesce_ratio")}},
         "numerics_match": bool(numerics_match),
+        "pipeline_overlap": _pipeline_summary(),
         "coalesce_ratio": round(stats["coalesce_ratio"], 2),
         "baseline": "same per-tensor allreduce_async loop with "
                     "HVD_CYCLE_TIME=0 (immediate dispatch, scheduler off; "
@@ -426,6 +442,7 @@ def run_pipeline_bench(args) -> None:
                       "pipeline": stats["pipeline"],
                       "chunked_plan_builds": cache_stats["chunked_builds"]},
         "numerics_match": bool(numerics_match),
+        "pipeline_overlap": _pipeline_summary(),
         "overlap_ratio": round(stats["pipeline"]["overlap_ratio"], 3),
         "slot_occupancy": round(stats["pipeline"]["slot_occupancy"], 3),
         "baseline": "same large-tensor allreduce_async stream with "
@@ -436,6 +453,375 @@ def run_pipeline_bench(args) -> None:
                    "bytes_per_tensor": args.pipeline_size,
                    "chunks": args.pipeline_chunks, "dtype": "float32",
                    "iters": args.pipeline_iters, "n_chips": n,
+                   "backend": jax.devices()[0].platform},
+    }))
+
+
+def run_overlap_bench(args) -> None:
+    """Flush-level overlap microbench (CPU backend, virtual 8-chip mesh):
+    a stream of medium async allreduces where EVERY submission is its own
+    threshold-triggered flush — the multi-flush stream the pipelined
+    executor exists for. The ``--pipeline-bench`` stream coalesces each
+    round into ONE synchronize-triggered flush, which by construction can
+    never hold two flushes in flight (BENCH_r08/r09's ``overlap_ratio:
+    0.0`` was the metric honestly reporting that workload, compounded by
+    post-retirement depth sampling — ISSUE 6). Chunking is disabled so
+    the measured effect is purely flush k+1 dispatching while flush k's
+    collective is in flight. Prints ONE JSON line; ci.sh gates
+    ``overlap_ratio > 0`` with >= 2 slots."""
+    import jax.numpy as jnp  # noqa: F811 - local for clarity
+
+    from horovod_tpu.ops import dispatch_cache, fusion_cycle
+
+    hvd, n = _microbench_mesh()
+    count = args.overlap_tensors
+    elems = args.overlap_size // 4  # float32 -> 4 bytes/elem
+    tensors = [
+        hvd.per_rank([jnp.full((elems,), float(r + 1) * 0.25 ** i,
+                               jnp.float32) for r in range(n)])
+        for i in range(count)
+    ]
+
+    def one_round():
+        handles = [hvd.allreduce_async(t, op=hvd.Sum) for t in tensors]
+        return [h.synchronize() for h in handles]
+
+    knobs = ("HVD_CYCLE_TIME", "HVD_PENDING_CYCLE_TIME",
+             "HVD_FUSION_THRESHOLD", "HVD_MAX_INFLIGHT_FLUSHES",
+             "HVD_PIPELINE_THRESHOLD")
+    prev = {k: os.environ.get(k) for k in knobs}
+    try:
+        # timer quiet; threshold of 1 byte = every submission drains its
+        # own flush at enqueue; chunking off (threshold unreachable) so
+        # only flush-level overlap differs between the modes.
+        os.environ["HVD_CYCLE_TIME"] = "500"
+        os.environ["HVD_PENDING_CYCLE_TIME"] = "500"
+        os.environ["HVD_FUSION_THRESHOLD"] = "1"
+        os.environ["HVD_PIPELINE_THRESHOLD"] = str(1 << 30)
+        os.environ["HVD_MAX_INFLIGHT_FLUSHES"] = "1"
+        dispatch_cache.reset()
+        fusion_cycle.reset()
+        ref_out = [np.asarray(o) for o in one_round()]
+        off_ms = _median_ms(one_round, args.overlap_iters)
+        os.environ["HVD_MAX_INFLIGHT_FLUSHES"] = str(args.overlap_slots)
+        dispatch_cache.reset()
+        fusion_cycle.reset()
+        on_out = [np.asarray(o) for o in one_round()]
+        on_ms = _median_ms(one_round, args.overlap_iters)
+        stats = hvd.fusion_stats()
+        summary = _pipeline_summary()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    numerics_match = all(np.allclose(a, b) for a, b in zip(ref_out, on_out))
+    reduction = (off_ms - on_ms) / off_ms * 100.0 if off_ms else 0.0
+    print(json.dumps({
+        "metric": "eager_flush_overlap_ratio",
+        "value": summary["overlap_ratio"],
+        "unit": "fraction of flushes dispatched while >=1 earlier flush "
+                "was still in flight on device",
+        "wall_time_reduction_pct": round(reduction, 1),
+        "synchronous": {"ms_per_round": round(off_ms, 4)},
+        "pipelined": {"ms_per_round": round(on_ms, 4),
+                      "pipeline": stats["pipeline"]},
+        "numerics_match": bool(numerics_match),
+        "pipeline_overlap": summary,
+        "baseline": "same per-flush allreduce_async stream with "
+                    "HVD_MAX_INFLIGHT_FLUSHES=1 (synchronous flush "
+                    "executor; chunking disabled in both modes)",
+        "config": {"op": "allreduce_async", "tensors": count,
+                   "bytes_per_tensor": args.overlap_size,
+                   "slots": args.overlap_slots, "dtype": "float32",
+                   "iters": args.overlap_iters, "n_chips": n,
+                   "backend": jax.devices()[0].platform},
+    }))
+
+
+def _step_bench_case(kind, hvd, n, args):
+    """One eager data-parallel training setup: returns (label, local_fn,
+    state0 host trees, sharded inputs, grad_bytes). ``local_fn`` is the
+    jitted shard_map'd LOCAL backward (no collectives inside): per-rank
+    gradients come back stacked on the leading rank axis, exactly a
+    PerRank layout — gradient sync then happens EAGERLY through
+    DistributedOptimizer, which is the path under test."""
+    import jax.numpy as jnp  # noqa: F811 - local for clarity
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = hvd.mesh()
+    axis = hvd.axis_name()
+    batch = args.step_batch
+
+    if kind == "resnet50":
+        from horovod_tpu.models import ResNet50
+        num_classes = 100
+        img = args.step_image_size
+        # ResNet-50 (the repo's benchmark workhorse): ~95 MB of f32
+        # gradients — squarely in the bucketing regime (several 64 MiB
+        # production buckets; several 16 MiB bench buckets). Tiny input
+        # resolution keeps the conv compute CI-sized without shrinking
+        # the gradient payload, which is what this bench stresses.
+        model = ResNet50(num_classes=num_classes, dtype=jnp.float32,
+                         axis_name=None)  # BN stats stay rank-local
+        x_host = np.random.default_rng(0).standard_normal(
+            (n * batch, img, img, 3)).astype(np.float32)
+        y_host = np.random.default_rng(1).integers(
+            0, num_classes, size=(n * batch,))
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, img, img, 3), jnp.float32),
+                               train=True)
+        params0 = variables["params"]
+        stats0 = variables["batch_stats"]
+
+        def local(p, stats_i, x_i, y_i):
+            def loss_fn(p):
+                logits, mut = model.apply(
+                    {"params": p, "batch_stats": stats_i}, x_i,
+                    train=True, mutable=["batch_stats"])
+                one_hot = jax.nn.one_hot(y_i, num_classes)
+                loss = -jnp.mean(jnp.sum(
+                    one_hot * jax.nn.log_softmax(logits), -1))
+                return loss, mut["batch_stats"]
+            (loss, new_stats), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            return g, new_stats, loss
+    else:
+        from horovod_tpu.models import TransformerConfig, TransformerLM
+        seq = args.step_seq_len
+        # vocab-heavy LM: the 32k-vocab embedding + lm_head gradients
+        # (~33 MB each) put the ~75 MB grad tree in the bucketing
+        # regime while the 2-layer trunk keeps CI compute small
+        cfg = TransformerConfig(vocab_size=32768, num_layers=2,
+                                num_heads=8, d_model=256, d_ff=1024,
+                                max_seq_len=seq, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        x_host = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(n * batch, seq))
+        y_host = x_host  # next-token objective shifts inside the loss
+        params0 = model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, seq), jnp.int32))["params"]
+        stats0 = {}
+
+        def local(p, stats_i, x_i, y_i):
+            del stats_i
+
+            def loss_fn(p):
+                logits = model.apply({"params": p}, x_i)
+                tgt = jax.nn.one_hot(y_i[:, 1:], cfg.vocab_size)
+                return -jnp.mean(jnp.sum(
+                    tgt * jax.nn.log_softmax(logits[:, :-1]), -1))
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            return g, {}, loss
+
+    def shard_fn(p, stats, x_i, y_i):
+        stats_i = jax.tree.map(lambda a: a[0], stats)
+        g, new_stats, loss = local(p, stats_i, x_i, y_i)
+        return (jax.tree.map(lambda a: a[None], g),
+                jax.tree.map(lambda a: a[None], new_stats),
+                loss[None])
+
+    local_fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis)),
+        check_vma=False))
+    x = jax.device_put(x_host, NamedSharding(mesh, P(axis)))
+    y = jax.device_put(y_host, NamedSharding(mesh, P(axis)))
+    grad_bytes = sum(int(np.prod(l.shape)) * 4
+                     for l in jax.tree.leaves(params0))
+    return local_fn, params0, stats0, x, y, grad_bytes
+
+
+def _run_step_mode(hvd, local_fn, params0, stats0, x, y, bucket_bytes,
+                   iters):
+    """One timing pass of the eager DP step (HVD_BUCKET_BYTES pinned):
+    per-step wall times with every step materialized to completion (all
+    updated param leaves ready — the reference's eager
+    ``optimizer.step()`` semantics, where per-bucket completion
+    pipelining lands), plus the params after the warmup step from the
+    fixed init (numerics probe) and the overlap summary."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.ops import dispatch_cache, fusion_cycle
+
+    mesh = hvd.mesh()
+    axis = hvd.axis_name()
+    os.environ["HVD_BUCKET_BYTES"] = str(bucket_bytes)
+    dispatch_cache.reset()
+    fusion_cycle.reset()
+    n = hvd.size()
+
+    params = jax.device_put(params0, NamedSharding(mesh, P()))
+    stats = jax.device_put(
+        jax.tree.map(lambda a: np.broadcast_to(a[None], (n,) + a.shape),
+                     stats0),
+        NamedSharding(mesh, P(axis)))
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt = jax.device_put(tx.init(params0), NamedSharding(mesh, P()))
+    state = {"params": params, "stats": stats, "opt": opt}
+
+    def one_step():
+        g, state["stats"], loss = local_fn(
+            state["params"], state["stats"], x, y)
+        gt = jax.tree.map(lambda a: hvd.PerRank(a), g)
+        updates, state["opt"] = tx.update(gt, state["opt"],
+                                          state["params"])
+        state["params"] = optax.apply_updates(state["params"], updates)
+        return loss
+
+    # warmup (compiles this mode's fuse/wire plans); materializing it
+    # doubles as the numerics probe — params after ONE step from init
+    one_step()
+    step1 = [np.asarray(l) for l in jax.tree.leaves(state["params"])]
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        one_step()
+        jax.block_until_ready(jax.tree.leaves(state["params"]))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return times, step1, _pipeline_summary()
+
+
+def _grad_sync_ms(hvd, grads_pr, bucket_bytes, iters=7):
+    """Median latency (ms) of syncing the model's ACTUAL gradient tree to
+    device completion — the mechanism's direct measurement: bucketed
+    dispatch pipelines fuse/wire/split across buckets, so time-to-ready
+    drops even where the 2-core CI box can't run comm and compute
+    concurrently. Robust where chained-step wall time is noise-bound."""
+    from horovod_tpu.ops import dispatch_cache, fusion_cycle
+    from horovod_tpu.ops.compression import Compression
+    from horovod_tpu.ops.reduce_ops import ReduceOp
+    from horovod_tpu.optim import _allreduce_tree
+
+    os.environ["HVD_BUCKET_BYTES"] = str(bucket_bytes)
+    dispatch_cache.reset()
+    fusion_cycle.reset()
+
+    def sync():
+        out = _allreduce_tree(
+            grads_pr, op=ReduceOp.AVERAGE, process_set=None,
+            compression=Compression.none, prescale_factor=1.0,
+            postscale_factor=1.0, axis_name=None)
+        jax.block_until_ready(jax.tree.leaves(out))
+
+    sync()
+    sync()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def run_step_bench(args) -> None:
+    """End-to-end eager data-parallel step-time benchmark (CPU backend,
+    virtual 8-chip mesh) for the bucketed backward-pass overlap (ISSUE 6
+    tentpole b): per step, a jitted shard_map program computes LOCAL
+    per-rank gradients (no collectives in the program), then
+    ``DistributedOptimizer`` syncs them eagerly — whole-tree
+    (``HVD_BUCKET_BYTES=0``, one grouped allreduce: the pre-bucketing
+    behavior) vs bucketed (each size-bounded bucket its own flushed async
+    grouped allreduce overlapping the next bucket's fuse and the update
+    math). Models: ``models/`` ResNet-50 and TransformerLM. Prints ONE
+    JSON line; ci.sh gates numerics parity and bucketed-not-slower on
+    the ResNet model. Step time is end-to-end (backward + sync + update),
+    not a collective microbench."""
+    hvd, n = _microbench_mesh()
+    knobs = ("HVD_BUCKET_BYTES", "HVD_CYCLE_TIME", "HVD_PENDING_CYCLE_TIME")
+    prev = {k: os.environ.get(k) for k in knobs}
+    models = {}
+    try:
+        # timer quiet: every bucket flush comes from the explicit
+        # "bucket" trigger (deterministic composition, no mid-step
+        # timer fires on a loaded CI box)
+        os.environ["HVD_CYCLE_TIME"] = "500"
+        os.environ["HVD_PENDING_CYCLE_TIME"] = "500"
+        for kind in ("resnet50", "transformer"):
+            local_fn, params0, stats0, x, y, grad_bytes = _step_bench_case(
+                kind, hvd, n, args)
+            # interleaved A/B/A/B passes, per-mode median over the
+            # pooled per-step samples: both modes see the same load
+            # drift (a 2-core CI box emulating 8 chips swings 30%
+            # run-to-run; back-to-back mode blocks would charge the
+            # drift to whichever mode ran second)
+            base_t1, base_params, _ = _run_step_mode(
+                hvd, local_fn, params0, stats0, x, y, 0, args.step_iters)
+            bkt_t1, bkt_params, overlap = _run_step_mode(
+                hvd, local_fn, params0, stats0, x, y,
+                args.step_bucket_bytes, args.step_iters)
+            base_t2, _, _ = _run_step_mode(
+                hvd, local_fn, params0, stats0, x, y, 0, args.step_iters)
+            bkt_t2, _, _ = _run_step_mode(
+                hvd, local_fn, params0, stats0, x, y,
+                args.step_bucket_bytes, args.step_iters)
+            base_ms = float(np.median(base_t1 + base_t2))
+            bkt_ms = float(np.median(bkt_t1 + bkt_t2))
+            match = all(np.allclose(a, b)
+                        for a, b in zip(base_params, bkt_params))
+            # gradient-sync latency on the model's real grad tree
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh, axisn = hvd.mesh(), hvd.axis_name()
+            g, _, _ = local_fn(
+                jax.device_put(params0, NamedSharding(mesh, P())),
+                jax.device_put(
+                    jax.tree.map(lambda a: np.broadcast_to(
+                        a[None], (n,) + a.shape), stats0),
+                    NamedSharding(mesh, P(axisn))), x, y)
+            grads_pr = jax.tree.map(lambda a: hvd.PerRank(a), g)
+            sync_whole = _grad_sync_ms(hvd, grads_pr, 0)
+            sync_bkt = _grad_sync_ms(hvd, grads_pr,
+                                     args.step_bucket_bytes)
+            from horovod_tpu.optim import _bucket_layout
+            n_buckets = len(_bucket_layout(
+                [int(np.prod(l.shape)) * 4
+                 for l in jax.tree.leaves(params0)],
+                args.step_bucket_bytes))
+            models[kind] = {
+                "whole_tree_ms_per_step": round(base_ms, 3),
+                "bucketed_ms_per_step": round(bkt_ms, 3),
+                "reduction_pct": round(
+                    (base_ms - bkt_ms) / base_ms * 100.0, 1) if base_ms
+                    else 0.0,
+                "grad_sync_whole_ms": round(sync_whole, 3),
+                "grad_sync_bucketed_ms": round(sync_bkt, 3),
+                "grad_sync_reduction_pct": round(
+                    (sync_whole - sync_bkt) / sync_whole * 100.0, 1)
+                    if sync_whole else 0.0,
+                "numerics_match": bool(match),
+                "grad_bytes": grad_bytes,
+                "buckets": n_buckets,
+                "pipeline_overlap": overlap,
+            }
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    print(json.dumps({
+        "metric": "bucketed_backward_step_time_reduction",
+        "value": models["resnet50"]["reduction_pct"],
+        "unit": "% reduction in end-to-end eager DP step time, ResNet-50 "
+                "(bucketed backward vs whole-tree allreduce)",
+        "models": models,
+        "pipeline_overlap": models["resnet50"]["pipeline_overlap"],
+        "numerics_match": bool(all(m["numerics_match"]
+                                   for m in models.values())),
+        "baseline": "identical eager DP step with HVD_BUCKET_BYTES=0 "
+                    "(whole gradient pytree as one post-backward grouped "
+                    "allreduce — the pre-ISSUE-6 DistributedOptimizer "
+                    "behavior)",
+        "config": {"bucket_bytes": args.step_bucket_bytes,
+                   "batch_per_chip": args.step_batch,
+                   "image_size": args.step_image_size,
+                   "seq_len": args.step_seq_len,
+                   "iters": args.step_iters, "n_chips": n,
                    "backend": jax.devices()[0].platform},
     }))
 
@@ -511,6 +897,48 @@ def main():
     parser.add_argument("--pipeline-chunks", type=int, default=4,
                         help="HVD_PIPELINE_CHUNKS for the pipelined mode "
                              "of --pipeline-bench")
+    parser.add_argument("--overlap-bench", action="store_true",
+                        help="run the flush-overlap microbench (CPU "
+                             "backend, no accelerator probe): per-flush "
+                             "allreduce_async stream, "
+                             "HVD_MAX_INFLIGHT_FLUSHES=2 vs 1, gating "
+                             "overlap_ratio > 0")
+    parser.add_argument("--overlap-iters", type=int, default=12,
+                        help="timed submit+synchronize rounds per mode in "
+                             "--overlap-bench")
+    parser.add_argument("--overlap-tensors", type=int, default=6,
+                        help="async allreduces (= flushes) per round in "
+                             "--overlap-bench")
+    parser.add_argument("--overlap-size", type=int, default=1024 * 1024,
+                        help="bytes per tensor in --overlap-bench "
+                             "(default 1 MiB: big enough that a flush's "
+                             "collective is still in flight when the next "
+                             "flush dispatches)")
+    parser.add_argument("--overlap-slots", type=int, default=2,
+                        help="HVD_MAX_INFLIGHT_FLUSHES for the pipelined "
+                             "mode of --overlap-bench")
+    parser.add_argument("--step-bench", action="store_true",
+                        help="run the end-to-end eager DP step-time "
+                             "benchmark (CPU backend, no accelerator "
+                             "probe): models/ ResNet-50 + TransformerLM, "
+                             "bucketed backward (HVD_BUCKET_BYTES) vs "
+                             "whole-tree allreduce")
+    parser.add_argument("--step-iters", type=int, default=10,
+                        help="timed steps per mode/model in --step-bench")
+    parser.add_argument("--step-batch", type=int, default=2,
+                        help="per-chip batch size in --step-bench")
+    parser.add_argument("--step-image-size", type=int, default=16,
+                        help="ResNet input resolution in --step-bench "
+                             "(small: the bench isolates sync overlap, "
+                             "not conv throughput)")
+    parser.add_argument("--step-seq-len", type=int, default=64,
+                        help="transformer sequence length in --step-bench")
+    parser.add_argument("--step-bucket-bytes", type=int,
+                        default=4 * 1024 * 1024,
+                        help="HVD_BUCKET_BYTES for the bucketed mode of "
+                             "--step-bench (default 4 MiB so the small "
+                             "bench models split into several buckets; "
+                             "production default is 64 MiB)")
     parser.add_argument("--max-wait", type=float, default=600.0,
                         help="max seconds to wait for the accelerator "
                              "backend to answer a clean-exit probe before "
@@ -526,6 +954,10 @@ def main():
         return run_cycle_bench(args)
     if args.pipeline_bench:
         return run_pipeline_bench(args)
+    if args.overlap_bench:
+        return run_overlap_bench(args)
+    if args.step_bench:
+        return run_step_bench(args)
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
         # Claiming the backend ourselves now would either fail identically
@@ -686,6 +1118,7 @@ def main():
         "timing": {"method": "chained_windows", "window": window,
                    "n_windows": n_windows,
                    "timed_steps": window * n_windows},
+        "pipeline_overlap": _pipeline_summary(),
         "loss_first": round(losses[0], 4),
         "loss_last": round(losses[-1], 4),
         "loss_decreased": bool(losses[-1] < losses[0]),

@@ -34,7 +34,7 @@ python -m tools.hvdlint horovod_tpu
 # backend") are now SKIPPED via tests/backend_markers.py, so the dot
 # count is a clean signal. Raise this when the environment's pass level
 # rises; override with T1_MIN_PASSED.
-T1_MIN_PASSED="${T1_MIN_PASSED:-520}"
+T1_MIN_PASSED="${T1_MIN_PASSED:-536}"
 
 step "1/6 tier-1 gate (the ROADMAP.md command; floor: $T1_MIN_PASSED passed)"
 # faulthandler_timeout: a hung test (e.g. a flush-executor deadlock) dumps
@@ -83,6 +83,49 @@ assert d['value'] is not None and d['value'] >= 20.0, \
 print('pipeline bench OK: %.1f%% wall-time reduction (%.1f -> %.1f ms/round)'
       % (d['value'], d['synchronous']['ms_per_round'],
          d['pipelined']['ms_per_round']))"
+
+step "1g/6 flush-overlap microbench (the executor must actually hold two flushes in flight)"
+python bench.py --overlap-bench --overlap-iters 8 | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+assert d['numerics_match'] is True, d
+assert d['value'] is not None and d['value'] > 0.0, \
+    'pipelined executor shows zero flush overlap with >=2 slots: %r' % d
+p = d['pipelined']['pipeline']
+assert p['executed'] >= 2, d
+print('overlap bench OK: overlap_ratio %.2f (peak depth %d, '
+      'device_wait %.1f ms, %.1f%% wall-time reduction)' % (
+          d['value'], p['inflight_peak'], p['device_wait_ms'],
+          d['wall_time_reduction_pct']))"
+
+step "1i/6 bucketed step bench (bucketed backward must not be slower than whole-tree)"
+# End-to-end eager DP step time, models/ ResNet-50: HVD_BUCKET_BYTES
+# bucketing vs the whole-tree grouped allreduce. Hard gates: numerics
+# parity, nonzero overlap ratio, and bucketed gradient-sync latency no
+# slower than whole-tree + 5% (the mechanism's direct measurement on
+# the model's real grad tree; 7-sample medians on a loaded box still
+# jitter a few percent). The chained step-time gate allows 10% jitter
+# because the CI box is a 2-core CPU emulating 8 chips — comm and
+# compute fully contend there, so the chained wall clock carries that
+# much run-to-run noise (see BENCH_r10.json).
+python bench.py --step-bench --step-iters 5 --step-batch 1 \
+    --step-bucket-bytes 16777216 | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+assert d['numerics_match'] is True, d
+r = d['models']['resnet50']
+assert r['grad_sync_bucketed_ms'] <= r['grad_sync_whole_ms'] * 1.05, \
+    'bucketed gradient sync slower than whole-tree beyond CI noise: %r' % r
+assert r['bucketed_ms_per_step'] <= r['whole_tree_ms_per_step'] * 1.10, \
+    'bucketed backward slower than whole-tree beyond CI noise: %r' % r
+assert r['pipeline_overlap']['overlap_ratio'] > 0.0, \
+    'bucketed backward shows zero comm overlap: %r' % r
+print('step bench OK: resnet50 step %.0f -> %.0f ms (%.1f%%), grad sync '
+      '%.0f -> %.0f ms (%.1f%%), overlap_ratio %.2f, %d buckets' % (
+          r['whole_tree_ms_per_step'], r['bucketed_ms_per_step'],
+          r['reduction_pct'], r['grad_sync_whole_ms'],
+          r['grad_sync_bucketed_ms'], r['grad_sync_reduction_pct'],
+          r['pipeline_overlap']['overlap_ratio'], r['buckets']))"
 
 if [[ "${1:-}" == "--fast" ]]; then
   step "fast: examples/mnist.py (hvdrun -np 2) then exit"

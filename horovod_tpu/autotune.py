@@ -38,6 +38,8 @@ TPU rebuild; ``operations.cc:584-594``):
 * ``PIPELINE_CHUNKS`` — chunk count for the large-buffer wire pipeline
   (consumer: ``ops/collectives._chunk_layout`` via the chunked dispatch
   plans, which rebuild on the override-epoch bump).
+* ``BUCKET_BYTES`` — gradient bucket size for the eager backward-pass
+  comm/compute overlap (consumer: ``optim/_bucketed_allreduce``).
 * ``HIERARCHICAL_ALLREDUCE`` — flat vs two-level ICI/DCN schedule
   (consumer: ``ops/hierarchical.hierarchical_enabled_for``).
 * ``CACHE_CAPACITY`` — dispatch-plan/response cache on/off (the
@@ -131,6 +133,14 @@ def _default_tunables() -> list[Tunable]:
         # desynchronize programs). Flipping it bumps the envs override
         # epoch, which rebuilds the chunked dispatch plans.
         Tunable(envs.PIPELINE_CHUNKS, [envs.DEFAULT_PIPELINE_CHUNKS, 2, 8]),
+        # Gradient bucket size for the eager backward-pass overlap
+        # (consumer: optim/_bucketed_allreduce, which re-reads the knob
+        # per update). First candidate = the default so enabling autotune
+        # changes nothing at sample 0. Bucket layout is a pure function
+        # of leaf sizes + this knob, and decisions sync through rank 0,
+        # so multi-process composition stays rank-deterministic.
+        Tunable(envs.BUCKET_BYTES, [envs.DEFAULT_BUCKET_BYTES,
+                                    8 * MB, 16 * MB, 32 * MB, 128 * MB]),
         Tunable(envs.HIERARCHICAL_ALLREDUCE, [0, 1]),
         # Dispatch-plan/response cache on/off, the reference's cache_enabled
         # tunable (parameter_manager.cc CacheEnabledParameter). Default-on

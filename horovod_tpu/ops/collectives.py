@@ -2201,6 +2201,16 @@ def join() -> int:
 # immediate per-call dispatch.
 # ---------------------------------------------------------------------------
 
+def _result_arrays(result) -> list:
+    """The device arrays carried by a handle result. PerRank bundles are
+    opaque leaves to the jax.tree utilities — ``jax.block_until_ready``
+    silently skips them and ``is_ready`` probes default to True — so
+    readiness checks and device blocks must unwrap to ``.array``, inside
+    grouped result lists too."""
+    seq = result if isinstance(result, (list, tuple)) else [result]
+    return [r.array if isinstance(r, PerRank) else r for r in seq]
+
+
 class Handle:
     """Completion handle for *_async ops. The result may still be queued
     in the fusion cycle (dispatched at the next flush) or already in
@@ -2237,19 +2247,43 @@ class Handle:
             result = self._materialize()
         except Exception:
             return True  # completed in error; synchronize() raises it
-        leaves = jax.tree.leaves(
-            result.array if isinstance(result, PerRank) else result)
+        leaves = jax.tree.leaves(_result_arrays(result))
         return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
 
     def synchronize(self):
         if self._synced:
             return self._result
         result = self._materialize()
-        jax.block_until_ready(
-            result.array if isinstance(result, PerRank) else result)
+        jax.block_until_ready(_result_arrays(result))
         self._result = result
         self._synced = True
         return self._result
+
+    def flush(self) -> None:
+        """Dispatch the op NOW if it is still queued in the fusion cycle
+        (non-blocking; no-op on an already-dispatched handle). The
+        bucketed optimizer path calls this after each bucket's submission
+        so bucket k's collective is in flight while bucket k+1 fuses
+        host-side — without waiting for a threshold or cycle trigger."""
+        # immediate-path handles are already dispatched
+
+    def result(self):
+        """The dispatched result WITHOUT blocking on device completion
+        (``synchronize()`` is the blocking wait): downstream eager ops
+        chain on in-flight arrays through device-side data dependencies,
+        so update math can run while later buckets' collectives are
+        still on the wire. Re-raises a failed flush's error.
+
+        On backends where that chaining is unsafe
+        (``envs.eager_chain_enabled``: the XLA CPU client's shared
+        thread pool lets consumer programs starve an in-flight
+        collective's rendezvous — a reproduced deadlock) this degrades
+        to ``synchronize()``."""
+        if self._synced:
+            return self._result
+        if not envs.eager_chain_enabled(jax.devices()[0].platform):
+            return self.synchronize()
+        return self._materialize()
 
 
 class _QueuedHandle(Handle):
@@ -2270,6 +2304,10 @@ class _QueuedHandle(Handle):
         from . import fusion_cycle
         results = fusion_cycle.scheduler().wait_result(self._entry)
         return list(results) if self._entry.grouped else results[0]
+
+    def flush(self) -> None:
+        from . import fusion_cycle
+        fusion_cycle.scheduler().flush_entry(self._entry, "bucket")
 
 
 def _is_custom_compressor(compression) -> bool:
@@ -2381,6 +2419,10 @@ class _MultiHandle(Handle):
         # device block) — poll() must stay non-blocking; synchronize()
         # adds the block_until_ready over the whole list in Handle
         return [h._materialize() for h in self._handles]
+
+    def flush(self) -> None:
+        for h in self._handles:
+            h.flush()
 
 
 def alltoall_async(tensor, splits=None, **kw) -> Handle:

@@ -104,7 +104,8 @@ from ..utils import invariants as _inv
 from ..utils import logging as hvd_logging
 
 FLUSH_TRIGGERS = ("threshold", "cycle", "synchronize", "poll", "barrier",
-                  "join", "shutdown", "backpressure", "name-reuse")
+                  "join", "shutdown", "backpressure", "name-reuse",
+                  "bucket")
 
 # In-flight window multiplier: after a dispatch the scheduler flushes at
 # the PENDING_CYCLE_TIME pace for one cycle window (see _age_limit_s).
@@ -248,6 +249,7 @@ class FusionScheduler:
         self._pstats = {
             "submitted": 0, "executed": 0, "overlapped": 0,
             "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
+            "device_wait_ms": 0.0,
         }
 
     # -- enqueue -----------------------------------------------------------
@@ -448,7 +450,10 @@ class FusionScheduler:
                 while not self._exec_q:
                     if self._exec_stop:
                         return
-                    self._exec_cv.wait(0.5)
+                    # plain wait, no poll timeout: every producer path
+                    # (submit, abort, stop) notifies under _exec_cv, so an
+                    # idle pipeline sleeps instead of waking twice a second
+                    self._exec_cv.wait()
                 batch = self._exec_q.popleft()
                 self._exec_busy = True
             try:
@@ -483,7 +488,21 @@ class FusionScheduler:
         """Bound the in-flight window: at most ``HVD_MAX_INFLIGHT_FLUSHES``
         dispatched-but-device-incomplete flushes. Admission past the
         window blocks on the OLDEST in-flight flush (FIFO retirement —
-        completion timing never reorders anything)."""
+        completion timing never reorders anything).
+
+        Overlap metrics are sampled *before* eager retirement — the
+        pre-ISSUE-6 accounting retired completed flushes first, so it
+        read depth 0 whenever device completion beat the next admission,
+        under-reporting any overlap that did happen. Two samples with
+        distinct meanings: ``inflight_peak`` is the ADMISSION-time depth
+        (pipeline pressure as the batch arrives at the window), while
+        ``overlapped`` uses the POST-BLOCKING depth — a flush that had
+        to wait out every predecessor before dispatching (slots=1, the
+        documented synchronous mode) did not overlap anything and must
+        not count. Slot-blocking time accumulates into
+        ``device_wait_ms`` so a pipeline stalled on device completion is
+        visible in ``fusion_stats()["pipeline"]`` instead of hiding
+        inside dispatch wall time."""
         import jax
         # The in-flight window deque is executor-private state: only the
         # single dispatch thread may touch it (stop() clears it after the
@@ -491,24 +510,47 @@ class FusionScheduler:
         _inv.assert_thread(self._exec_thread,
                            "in-flight window admission (_admit_slot)")
         slots = max(envs.max_inflight_flushes(), 1)
-        while self._exec_inflight and all(
-                getattr(l, "is_ready", lambda: True)()
-                for l in self._exec_inflight[0]):
+
+        def _done(leaves) -> bool:
+            return all(getattr(l, "is_ready", lambda: True)()
+                       for l in leaves)
+
+        # admission-time sample, pre-retirement: earlier flushes still in
+        # flight on device as this batch arrives at the window (pipeline
+        # pressure — with 2 slots a saturated stream reads 2 here)
+        depth = sum(1 for leaves in self._exec_inflight
+                    if not _done(leaves))
+        while self._exec_inflight and _done(self._exec_inflight[0]):
             self._exec_inflight.popleft()  # retire completed without blocking
         waited = False
+        wait_s = 0.0
         while len(self._exec_inflight) >= slots:
             leaves = self._exec_inflight.popleft()
             waited = True
-            jax.block_until_ready(leaves)  # GIL released: producers run on
-        depth = len(self._exec_inflight)
+            t0 = time.monotonic()
+            with _timeline.pipeline_stage("SLOT_WAIT"):
+                jax.block_until_ready(leaves)  # GIL released: producers run on
+            wait_s += time.monotonic() - t0
+        # overlap sample, post-blocking: a flush only counts as
+        # OVERLAPPED if an earlier flush is still device-incomplete when
+        # it actually dispatches — i.e. after slot admission released it.
+        # Counting the pre-block depth would report overlap_ratio ~1.0
+        # for a slots=1 saturated stream, whose every dispatch waited out
+        # its predecessor (the documented synchronous mode).
+        live = sum(1 for leaves in self._exec_inflight
+                   if not _done(leaves))
+        # window depth after retirement/blocking: what actually remains
+        # in the slot window alongside the admitted batch (occupancy)
+        window_depth = len(self._exec_inflight)
         with self._exec_cv:
-            self._pstats["depth_sum"] += depth
-            if depth > 0:
+            self._pstats["depth_sum"] += window_depth
+            if live > 0:
                 self._pstats["overlapped"] += 1
             if depth > self._pstats["inflight_peak"]:
                 self._pstats["inflight_peak"] = depth
             if waited:
                 self._pstats["slot_waits"] += 1
+                self._pstats["device_wait_ms"] += wait_s * 1e3
         _timeline.record_inflight_depth(depth)
 
     def _track_inflight(self, entries: list[_Entry]) -> None:
@@ -521,7 +563,10 @@ class FusionScheduler:
                 arr = getattr(r, "array", r)  # PerRank carries .array
                 leaves.extend(x for x in jax.tree.leaves(arr)
                               if hasattr(x, "is_ready"))
-        self._exec_inflight.append(leaves)
+        if leaves:
+            # a batch with no readiness-bearing leaves (results already
+            # materialized, or a failed dispatch) never occupies a slot
+            self._exec_inflight.append(leaves)
 
     def quiesce(self) -> None:
         """Block until every submitted batch has been dispatched (entry
@@ -532,7 +577,9 @@ class FusionScheduler:
             return
         with self._exec_cv:
             while self._exec_q or self._exec_busy:
-                self._exec_cv.wait(0.1)
+                # plain wait: _submit and the executor's batch-complete
+                # finally block both notify under _exec_cv
+                self._exec_cv.wait()
 
     def _wait_names_clear(self, names) -> None:
         """Block until none of ``names`` is tracked as an in-flight svc
@@ -544,7 +591,10 @@ class FusionScheduler:
         names = set(names)
         with self._exec_cv:
             while not self._exec_names.isdisjoint(names):
-                self._exec_cv.wait(0.05)
+                # plain wait: every path that removes names (batch
+                # completion, abort, submit failure) notifies under
+                # _exec_cv
+                self._exec_cv.wait()
 
     # -- execution ---------------------------------------------------------
 
@@ -833,13 +883,21 @@ class FusionScheduler:
                 "queue_depth": len(self._exec_q),
                 "inflight_peak": self._pstats["inflight_peak"],
                 "slot_waits": self._pstats["slot_waits"],
+                # total ms the executor spent blocked on device
+                # completion at slot admission (window full) — a
+                # device-bound pipeline shows here, not in dispatch time
+                "device_wait_ms": self._pstats["device_wait_ms"],
                 # fraction of flushes dispatched while >=1 earlier flush
                 # was still in flight on device — the overlap the
-                # executor exists to create
+                # executor exists to create. Sampled BEFORE eager
+                # retirement but AFTER slot blocking, so a slots=1
+                # stream honestly reads 0.0 (docs/pipeline.md "Overlap
+                # semantics").
                 "overlap_ratio": (self._pstats["overlapped"] / executed
                                   if executed else 0.0),
                 # mean fraction of the slot window occupied at admission
-                # (the admitted batch itself counts as one slot)
+                # (the admitted batch itself counts as one slot;
+                # post-retirement window depth)
                 "slot_occupancy": (
                     (self._pstats["depth_sum"] / executed + 1.0) / slots
                     if executed else 0.0),
@@ -893,6 +951,7 @@ class FusionScheduler:
             self._pstats = {
                 "submitted": 0, "executed": 0, "overlapped": 0,
                 "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
+                "device_wait_ms": 0.0,
             }
 
 

@@ -8,6 +8,12 @@ TPU-native rebuild of the reference's optimizer surface:
   Here the allreduce is an ``optax.GradientTransformation`` stage, so under
   ``jit`` XLA fuses/overlaps the gradient collectives with the update math —
   the compiler plays the role of Horovod's fusion buffer + background cycle.
+  In EAGER mode the stage buckets the gradient pytree by
+  ``HVD_BUCKET_BYTES`` (default 64 MiB, the reference fusion-buffer scale)
+  and issues each bucket as its own flushed async grouped allreduce so
+  bucket k's collective hides under bucket k+1's host-side fuse and the
+  update math — the reference's backward-pass comm/compute overlap
+  (PAPER.md §L2), rebuilt on the pipelined flush executor.
 * ``backward_passes_per_step`` — local gradient aggregation, the analog of
   ``LocalGradientAggregationHelper``
   (``/root/reference/horovod/tensorflow/gradient_aggregation*.py``), via
@@ -23,6 +29,7 @@ import re
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from ..ops import collectives
@@ -30,6 +37,7 @@ from ..ops import sparse as sparse_ops
 from ..ops.compression import Compression, Compressor
 from ..ops.reduce_ops import ReduceOp
 from ..process_sets import ProcessSet
+from ..utils import envs
 
 
 def _path_str(path) -> str:
@@ -59,6 +67,109 @@ def _sparse_rows_for(path_str: str, sparse_gradient_paths, sparse_max_rows):
                     f"{pat!r} but sparse_max_rows has no entry for it")
             return int(sparse_max_rows)
     return None
+
+
+def _leaf_nbytes(leaf) -> int:
+    """Per-rank payload bytes of one gradient leaf (PerRank bundles drop
+    the rank axis) — the accounting the bucket layout partitions on.
+    Derives from static shape/dtype only, so every rank computes the
+    identical layout for the same gradient tree."""
+    if isinstance(leaf, collectives.PerRank):
+        arr = leaf.array
+        rows = max(int(arr.shape[0]), 1)
+        return max(int(arr.nbytes) // rows, 1)
+    nbytes = getattr(leaf, "nbytes", None)
+    if nbytes is not None:
+        return max(int(nbytes), 1)
+    return int(jnp.dtype(jnp.result_type(leaf)).itemsize)
+
+
+def _bucket_layout(sizes, cap: int) -> list[list[int]]:
+    """Partition leaf indices into contiguous buckets of at most ``cap``
+    bytes each, walking the flattened gradient tree in REVERSE traversal
+    order — the backward pass produces the last layers' gradients first,
+    so reverse-order buckets approximate gradient production order (the
+    reference fusion buffer fills the same way). The layout is a pure
+    function of the leaf sizes, so every rank issues the identical
+    bucket stream in the identical order (the PR-2/3 rank-deterministic
+    composition contract). A single leaf larger than ``cap`` forms its
+    own bucket; indices stay reverse-traversal-ordered within and across
+    buckets."""
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(sizes))):
+        if cur and cur_bytes + sizes[i] > cap:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += sizes[i]
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _bucketed_allreduce(leaves, *, op, process_set, compression,
+                        prescale_factor, postscale_factor, axis_name):
+    """Sync the dense gradient leaves with backward-pass comm/compute
+    overlap (``HVD_BUCKET_BYTES``, default 64 MiB): partition into
+    size-bounded reverse-traversal buckets, issue each bucket as its own
+    ``grouped_allreduce_async`` and flush it immediately — bucket k's
+    collective is then in flight on device while bucket k+1 fuses
+    host-side and, downstream, the optax update math chains on completed
+    buckets (results are collected without a device block; data
+    dependencies order execution). Numerics are identical to the
+    whole-tree grouped call: the reduction is elementwise per leaf, and
+    fusion only changes wire packaging.
+
+    Falls back to the single whole-tree grouped dispatch when bucketing
+    is off (``HVD_BUCKET_BYTES=0``), the tree fits one bucket, or the
+    leaves are tracers (traced mode: XLA's combiner/scheduler already
+    overlaps per-leaf collectives with backward compute).
+
+    Where ``envs.eager_chain_enabled`` says consumer math must not chain
+    on in-flight results (XLA CPU: its shared per-device thread pool
+    lets the optax update programs starve an in-flight chunked
+    collective's rendezvous — a reproduced hard deadlock), results are
+    materialized before they return; overlap BETWEEN buckets is
+    untouched (all buckets are submitted before the first collection
+    blocks, and the flush executor pipelines them regardless)."""
+    tracers = any(collectives._contains_tracer(l) for l in leaves)
+
+    def sync(ts):
+        out = collectives.grouped_allreduce(
+            ts, op=op, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, axis_name=axis_name,
+            compression=compression)
+        if not tracers and not envs.eager_chain_enabled(
+                jax.devices()[0].platform):
+            jax.block_until_ready(collectives._result_arrays(out))
+        return out
+
+    cap = envs.bucket_bytes()
+    if cap <= 0 or len(leaves) < 2 or tracers:
+        return sync(leaves)
+    buckets = _bucket_layout([_leaf_nbytes(l) for l in leaves], cap)
+    if len(buckets) < 2:
+        return sync(leaves)
+    handles = []
+    for idxs in buckets:
+        h = collectives.grouped_allreduce_async(
+            [leaves[i] for i in idxs], op=op, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, axis_name=axis_name,
+            compression=compression)
+        # dispatch NOW (the "bucket" flush trigger): without this the
+        # bucket would sit queued until a threshold/cycle/synchronize
+        # trigger and nothing would overlap
+        h.flush()
+        handles.append((idxs, h))
+    out = [None] * len(leaves)
+    for idxs, h in handles:
+        for i, r in zip(idxs, h.result()):
+            out[i] = r
+    return out
 
 
 def _allreduce_tree(tree, *, op, process_set, compression, prescale_factor,
@@ -108,7 +219,10 @@ def _allreduce_tree(tree, *, op, process_set, compression, prescale_factor,
         # buffers are keyed by wire dtype (mixed-source-dtype grads share
         # one compressed buffer) and results are decompressed after the
         # split — no per-leaf compress/decompress op storm around the call.
-        reduced = collectives.grouped_allreduce(
+        # Eager trees larger than HVD_BUCKET_BYTES dispatch as a stream of
+        # per-bucket async grouped allreduces so communication overlaps
+        # the remaining host-side work (see _bucketed_allreduce).
+        reduced = _bucketed_allreduce(
             dense_leaves, op=op, process_set=process_set,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
             axis_name=axis_name, compression=compression)
@@ -158,6 +272,20 @@ def DistributedOptimizer(
     With ``backward_passes_per_step > 1`` gradients accumulate locally
     (running mean, matching ``average_aggregated_gradients=True``) and the
     allreduce + inner update run every k-th step.
+
+    Eager gradient trees larger than ``HVD_BUCKET_BYTES`` (default
+    64 MiB; ``0`` disables) sync as a stream of per-bucket async grouped
+    allreduces in stable reverse-traversal order — each bucket's
+    collective is in flight while the next bucket fuses, and results are
+    collected without a device block (where ``HVD_EAGER_CHAIN`` allows;
+    auto = off on the XLA CPU backend, where consumer programs racing an
+    in-flight collective deadlock its rendezvous) so the wrapped
+    optimizer's update math chains on completed buckets. Numerics are
+    identical to the
+    whole-tree call; bucket composition is a pure function of the leaf
+    shapes, so multi-process jobs stay rank-deterministic. Traced
+    (jit/shard_map) updates are untouched: XLA already schedules the
+    collectives against the backward compute.
 
     ``sparse_gradient_paths`` is a list of regexes matched against each
     gradient leaf's ``/``-joined key path (e.g. ``["embedding"]``); matching

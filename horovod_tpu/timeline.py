@@ -138,8 +138,10 @@ def record_cycle_flush(trigger: str) -> None:
 def record_inflight_depth(depth: int) -> None:
     """Instant ``INFLIGHT_DEPTH.<n>`` marker on the ``pipeline`` lane when
     the flush executor admits a batch: ``n`` is how many earlier flushes
-    are still in flight on device, so slot occupancy (and bubbles — long
-    stretches at depth 0) read straight off the trace."""
+    are still in flight on device at dispatch time (sampled BEFORE eager
+    retirement — docs/pipeline.md "Overlap semantics"), so achieved
+    overlap (and bubbles — long stretches at depth 0) read straight off
+    the trace."""
     if _active:
         record(PIPELINE_LANE, f"{INFLIGHT_DEPTH}.{int(depth)}",
                PHASE_INSTANT)
@@ -168,7 +170,9 @@ def pipeline_stage(stage: str) -> "op_range":
     the software-pipeline twin of the per-op ranges. The spans cover the
     *host-side dispatch* of each stage (device execution is asynchronous);
     overlap shows as DISPATCH spans packed back-to-back while earlier
-    chunks' collectives are still in flight."""
+    chunks' collectives are still in flight. ``PIPELINE_SLOT_WAIT`` spans
+    mark executor admission blocking on device completion (the window is
+    full) — their total is ``fusion_stats()["pipeline"]["device_wait_ms"]``."""
     return op_range(PIPELINE_LANE, f"PIPELINE_{stage}")
 
 

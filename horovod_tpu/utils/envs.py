@@ -50,6 +50,8 @@ DYNAMIC_ENGINE = "DYNAMIC_ENGINE"  # 0 disables multi-process negotiation
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
 GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
 SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # force sparse grads onto dense allreduce
+BUCKET_BYTES = "BUCKET_BYTES"  # gradient bucket size for backward-pass overlap (0 = whole-tree)
+EAGER_CHAIN = "EAGER_CHAIN"  # auto|1|0: let eager consumer math chain on in-flight collective results
 FLASH_ATTENTION = "FLASH_ATTENTION"  # opt into the Pallas flash kernel
 DEBUG_INVARIANTS = "DEBUG_INVARIANTS"  # dev-mode runtime invariant checker
 SPARK_START_TIMEOUT = "SPARK_START_TIMEOUT"  # spark barrier-task scheduling bound
@@ -245,6 +247,20 @@ def pipeline_chunks() -> int:
     return get_int(PIPELINE_CHUNKS, DEFAULT_PIPELINE_CHUNKS)
 
 
+# Gradient bucketing (optim/_bucketed_allreduce): the backward pass's
+# dense gradient pytree is partitioned into size-bounded buckets, each
+# issued as its own async grouped allreduce so bucket k's collective is
+# in flight while bucket k+1 fuses host-side. 64 MiB matches the
+# reference's fusion-buffer sweet spot (half the 128 MB threshold: big
+# enough to amortize dispatch, small enough that several buckets pipeline
+# through the executor's slots). 0 = whole-tree single grouped call.
+DEFAULT_BUCKET_BYTES = 64 * 1024 * 1024
+
+
+def bucket_bytes() -> int:
+    return get_int(BUCKET_BYTES, DEFAULT_BUCKET_BYTES)
+
+
 def pipeline_chunking_enabled() -> bool:
     """Large-buffer chunk pipelining rides the pipelined executor: it is
     part of the same overlap mechanism, and disabling the executor
@@ -293,3 +309,22 @@ def pipeline_pingpong_enabled(platform: str) -> bool:
     if val in ("0", "false", "no", "off"):
         return False
     return donation_effective(platform)
+
+
+def eager_chain_enabled(platform: str) -> bool:
+    """Whether eager consumer programs may chain on still-in-flight
+    collective results (``Handle.result()`` / the optimizer's gradient
+    sync returning before device completion). On the XLA CPU backend the
+    client runs every per-device execution on one shared thread pool, so
+    consumer programs racing an in-flight multi-program collective
+    (chunked wire dispatch, pipelined buckets) can occupy the pool while
+    blocked on the collective's outputs — starving the rendezvous of its
+    remaining participants and deadlocking the process (reproduced by
+    ``bench.py --step-bench``). 'auto' therefore chains off-CPU only;
+    on CPU results materialize before consumer math sees them."""
+    val = (get(EAGER_CHAIN, "auto") or "auto").strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    return platform not in ("cpu",)

@@ -333,6 +333,158 @@ def test_abort_mid_pipeline_no_deadlock(hvd, monkeypatch):
                                _sum_expected())
 
 
+# ------------------------------------------- overlap metrics (stub device)
+
+class _StubArray:
+    """Deterministic device-completion stand-in: ``is_ready`` flips on
+    command, ``block_until_ready`` (what slot admission calls through
+    ``jax.block_until_ready``) waits for it. ``wait_entered`` observes the
+    executor blocking on THIS array — releasing only after that makes the
+    depth sample deterministic (sampling precedes blocking)."""
+
+    def __init__(self):
+        self._ready = threading.Event()
+        self.wait_entered = threading.Event()
+
+    def is_ready(self):
+        return self._ready.is_set()
+
+    def block_until_ready(self):
+        self.wait_entered.set()
+        assert self._ready.wait(30.0), "stub never released"
+        return self
+
+    def release(self):
+        self._ready.set()
+
+
+def test_stub_device_overlap_metrics(monkeypatch):
+    """ISSUE 6 acceptance: with 2 slots and device completion controlled
+    by hand, dispatch-time depth must reach 2 (two earlier flushes in
+    flight when the third dispatches), overlap_ratio must be > 0, and
+    slot blocking must accumulate device_wait_ms. The pre-fix accounting
+    sampled depth AFTER eager retirement and slot blocking, which could
+    never observe the full window."""
+    monkeypatch.setenv("HVD_MAX_INFLIGHT_FLUSHES", "2")
+    sched = fusion_cycle.FusionScheduler()
+    stubs = [_StubArray() for _ in range(3)]
+
+    def fake_execute(spec, entries, ticket=None):
+        for e in entries:
+            e.results = [stubs[int(e.label)]]
+            e.tensors = ()
+            e.event.set()
+
+    sched._execute = fake_execute
+    spec = fusion_cycle._QueueSpec("allreduce", None, None)
+    entries = [fusion_cycle._Entry([None], False, 8, [str(i)])
+               for i in range(3)]
+    try:
+        for e in entries:
+            sched._submit(fusion_cycle._Batch(spec, [e], "threshold"))
+        # batches 0 and 1 dispatch without blocking (window not full);
+        # batch 2's admission samples depth 2 (stubs 0 and 1 both
+        # unready), then blocks on the OLDEST in-flight stub
+        assert stubs[0].wait_entered.wait(10.0), \
+            "executor never blocked on the full window"
+        time.sleep(0.02)  # measurable device_wait_ms
+        stubs[0].release()
+        # stub 1 stays unready until batch 2 has dispatched (quiesce
+        # returns after the batch completes): its post-blocking overlap
+        # sample must deterministically see one live predecessor
+        sched.quiesce()
+        for s in stubs[1:]:
+            s.release()
+        p = sched.stats()["pipeline"]
+        assert p["executed"] == 3
+        assert p["inflight_peak"] == 2, p
+        assert p["overlap_ratio"] == pytest.approx(2.0 / 3.0), p
+        assert p["slot_waits"] == 1, p
+        assert p["device_wait_ms"] > 0.0, p
+    finally:
+        for s in stubs:
+            s.release()
+        sched.stop()
+
+
+def test_stub_device_slots1_reports_zero_overlap(monkeypatch):
+    """slots=1 is the documented synchronous mode: every dispatch waits
+    out its predecessor at slot admission, so overlap_ratio must read
+    0.0 — the overlap sample is post-blocking — even though
+    admission-time pressure (inflight_peak) sees each predecessor still
+    in flight as the next batch arrives."""
+    monkeypatch.setenv("HVD_MAX_INFLIGHT_FLUSHES", "1")
+    sched = fusion_cycle.FusionScheduler()
+    stubs = [_StubArray() for _ in range(3)]
+
+    def fake_execute(spec, entries, ticket=None):
+        for e in entries:
+            e.results = [stubs[int(e.label)]]
+            e.tensors = ()
+            e.event.set()
+
+    sched._execute = fake_execute
+
+    def _release_when_blocked_on():
+        for s in stubs[:2]:  # the third is never blocked on
+            s.wait_entered.wait(10.0)
+            s.release()
+
+    releaser = threading.Thread(target=_release_when_blocked_on,
+                                daemon=True)
+    releaser.start()
+    spec = fusion_cycle._QueueSpec("allreduce", None, None)
+    try:
+        for i in range(3):
+            sched._submit(fusion_cycle._Batch(
+                spec, [fusion_cycle._Entry([None], False, 8, [str(i)])],
+                "threshold"))
+        sched.quiesce()
+        p = sched.stats()["pipeline"]
+        assert p["executed"] == 3
+        assert p["overlap_ratio"] == 0.0, p
+        assert p["inflight_peak"] == 1, p
+        assert p["slot_waits"] == 2, p
+        assert p["device_wait_ms"] > 0.0, p
+    finally:
+        for s in stubs:
+            s.release()
+        sched.stop()
+        releaser.join(timeout=10)
+
+
+def test_stub_device_no_overlap_when_synchronous(monkeypatch):
+    """Control for the stub test: a stream whose flushes complete before
+    the next admission reports zero overlap — the metric cannot invent
+    overlap that did not happen."""
+    monkeypatch.setenv("HVD_MAX_INFLIGHT_FLUSHES", "2")
+    sched = fusion_cycle.FusionScheduler()
+
+    def fake_execute(spec, entries, ticket=None):
+        for e in entries:
+            stub = _StubArray()
+            stub.release()  # device completes immediately
+            e.results = [stub]
+            e.tensors = ()
+            e.event.set()
+
+    sched._execute = fake_execute
+    spec = fusion_cycle._QueueSpec("allreduce", None, None)
+    try:
+        for i in range(3):
+            sched._submit(fusion_cycle._Batch(
+                spec, [fusion_cycle._Entry([None], False, 8, [str(i)])],
+                "threshold"))
+        sched.quiesce()
+        p = sched.stats()["pipeline"]
+        assert p["executed"] == 3
+        assert p["overlap_ratio"] == 0.0, p
+        assert p["inflight_peak"] == 0, p
+        assert p["device_wait_ms"] == 0.0, p
+    finally:
+        sched.stop()
+
+
 # ------------------------------------------------------------------- stats
 
 def test_fusion_stats_pipeline_fields(hvd):
@@ -340,7 +492,8 @@ def test_fusion_stats_pipeline_fields(hvd):
     p = st["pipeline"]
     for key in ("enabled", "max_inflight", "chunking", "submitted",
                 "executed", "queue_depth", "overlap_ratio",
-                "slot_occupancy", "inflight_peak", "slot_waits"):
+                "slot_occupancy", "inflight_peak", "slot_waits",
+                "device_wait_ms"):
         assert key in p
     assert "wire_programs" in st
 
