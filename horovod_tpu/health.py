@@ -40,6 +40,7 @@ import time
 from .exceptions import PeerFailureError
 from .utils import envs
 from .utils import faults as _faults
+from .utils import invariants as _inv
 from .utils import logging as hvd_logging
 from .utils import retry as _retry
 
@@ -116,8 +117,12 @@ class HealthWatchdog:
         # inspector / exchange deadline, exactly as before this PR.
         self._seen: dict[int, tuple[int | None, float | None]] = {}
         self._failed: tuple[int, str] | None = None
-        self._mu = threading.Lock()
-        self._stop = threading.Event()
+        # Through the invariants constructors so both the lock-order
+        # witness (HVD_DEBUG_INVARIANTS) and the hvdsched cooperative
+        # scheduler (HVD_SCHED_CHECK) cover the watchdog's failure
+        # domain alongside the fusion scheduler it aborts into.
+        self._mu = _inv.make_lock("health.watchdog.mu")
+        self._stop = _inv.make_event("health.watchdog.stop")
         self._thread: threading.Thread | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -129,17 +134,15 @@ class HealthWatchdog:
             for r in range(self.world_size):
                 if r != self.rank:
                     self._seen[r] = (None, None)
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True,
-            name=f"hvd-health-{self.rank}")
-        self._thread.start()
+        self._thread = _inv.spawn_thread(
+            self._loop, name=f"hvd-health-{self.rank}")
         _register(self)
 
     def stop(self) -> None:
         self._stop.set()
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5)
+            _inv.join_thread(t, timeout=5)
         self._thread = None
         _unregister(self)
 
@@ -269,7 +272,7 @@ class HealthWatchdog:
 
     def _check_peers(self):
         """Return ``(local rank, reason)`` for the first dead peer."""
-        now = time.monotonic()
+        now = _inv.monotonic()
         dead = self._check_poison()
         if dead is not None:
             return dead
@@ -296,7 +299,7 @@ class HealthWatchdog:
     def last_seen(self) -> dict[int, float | None]:
         """Seconds since each peer's beat counter last advanced, keyed by
         GLOBAL rank; None for a peer never seen beating."""
-        now = time.monotonic()
+        now = _inv.monotonic()
         with self._mu:
             return {self.global_ranks[r]:
                     (None if changed_at is None else now - changed_at)
@@ -338,7 +341,7 @@ def make_peer_failure_error(dead_rank: int, reason: str,
 
 # -- process-wide registry + the hvd.health_stats() surface -----------------
 
-_registry_mu = threading.Lock()
+_registry_mu = _inv.make_lock("health.registry.mu")
 _watchdogs: list[HealthWatchdog] = []
 
 

@@ -176,7 +176,7 @@ class _Entry:
         self.run = run
         self.queue_key = None
         self.label = label or (names[0] if names else "queued")
-        self.event = threading.Event()
+        self.event = _inv.make_event("fusion_cycle.entry")
         self.results = None
         self.error = None
 
@@ -222,8 +222,8 @@ class FusionScheduler:
         self._queues: "OrderedDict[tuple, _Queue]" = OrderedDict()
         self._pending_tensors = 0
         self._pending_bytes = 0
-        self._wake = threading.Event()
-        self._stop = threading.Event()
+        self._wake = _inv.make_event("fusion_cycle.scheduler.wake")
+        self._stop = _inv.make_event("fusion_cycle.scheduler.stop")
         self._thread: threading.Thread | None = None
         self._inflight_until = 0.0
         self._stats = {
@@ -288,7 +288,7 @@ class FusionScheduler:
             q = self._queues.get(key)
             if q is None:
                 q = _Queue(spec)
-                q.oldest_t = time.monotonic()
+                q.oldest_t = _inv.monotonic()
                 self._queues[key] = q
             q.entries.append(entry)
             q.names.update(entry.names)
@@ -337,7 +337,7 @@ class FusionScheduler:
             self._stats["flushed_bytes"] += q.nbytes
             self.flush_history.append(
                 (trigger, key, tuple(n for e in entries for n in e.names)))
-            self._inflight_until = time.monotonic() + (
+            self._inflight_until = _inv.monotonic() + (
                 _INFLIGHT_WINDOW_CYCLES * envs.cycle_time_ms() / 1e3)
             if pipelined:
                 # Register svc names with the executor's guard set in the
@@ -431,10 +431,8 @@ class FusionScheduler:
             self._pstats["submitted"] += 1
             if self._exec_thread is None or not self._exec_thread.is_alive():
                 self._exec_stop = False
-                self._exec_thread = threading.Thread(
-                    target=self._exec_loop, daemon=True,
-                    name="hvd-flush-pipeline")
-                self._exec_thread.start()
+                self._exec_thread = _inv.spawn_thread(
+                    self._exec_loop, name="hvd-flush-pipeline")
             self._exec_cv.notify_all()
 
     def _exec_loop(self) -> None:
@@ -527,10 +525,10 @@ class FusionScheduler:
         while len(self._exec_inflight) >= slots:
             leaves = self._exec_inflight.popleft()
             waited = True
-            t0 = time.monotonic()
+            t0 = _inv.monotonic()
             with _timeline.pipeline_stage("SLOT_WAIT"):
                 jax.block_until_ready(leaves)  # GIL released: producers run on
-            wait_s += time.monotonic() - t0
+            wait_s += _inv.monotonic() - t0
         # overlap sample, post-blocking: a flush only counts as
         # OVERLAPPED if an earlier flush is still device-incomplete when
         # it actually dispatches — i.e. after slot admission released it.
@@ -743,17 +741,16 @@ class FusionScheduler:
 
     def _ensure_thread_locked(self) -> None:
         if self._thread is None or not self._thread.is_alive():
-            self._stop = threading.Event()
-            self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="hvd-fusion-cycle")
-            self._thread.start()
+            self._stop = _inv.make_event("fusion_cycle.scheduler.stop")
+            self._thread = _inv.spawn_thread(
+                self._loop, name="hvd-fusion-cycle")
 
     def _age_limit_s(self) -> float:
         """Queue age that triggers a cycle flush: CYCLE_TIME idle,
         PENDING_CYCLE_TIME while work is in flight (a dispatch happened
         within the last cycle window)."""
         cycle = envs.cycle_time_ms() / 1e3
-        if time.monotonic() < self._inflight_until:
+        if _inv.monotonic() < self._inflight_until:
             return min(cycle, pending_cycle_time_ms() / 1e3)
         return cycle
 
@@ -761,7 +758,7 @@ class FusionScheduler:
         stop = self._stop
         while not stop.is_set():
             self._wake.clear()
-            now = time.monotonic()
+            now = _inv.monotonic()
             due: list[tuple] = []
             next_deadline = None
             with self._mu:
@@ -793,7 +790,7 @@ class FusionScheduler:
             if due:
                 continue
             timeout = (None if next_deadline is None
-                       else max(next_deadline - time.monotonic(), 0.0))
+                       else max(next_deadline - _inv.monotonic(), 0.0))
             self._wake.wait(timeout)
 
     # -- lifecycle / stats -------------------------------------------------
@@ -857,14 +854,14 @@ class FusionScheduler:
         self._wake.set()
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5)
+            _inv.join_thread(t, timeout=5)
         self._thread = None
         with self._exec_cv:
             self._exec_stop = True
             self._exec_cv.notify_all()
         t = self._exec_thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5)
+            _inv.join_thread(t, timeout=5)
         self._exec_thread = None
         self._exec_inflight.clear()
 

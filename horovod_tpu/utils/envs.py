@@ -54,6 +54,9 @@ BUCKET_BYTES = "BUCKET_BYTES"  # gradient bucket size for backward-pass overlap 
 EAGER_CHAIN = "EAGER_CHAIN"  # auto|1|0: let eager consumer math chain on in-flight collective results
 FLASH_ATTENTION = "FLASH_ATTENTION"  # opt into the Pallas flash kernel
 DEBUG_INVARIANTS = "DEBUG_INVARIANTS"  # dev-mode runtime invariant checker
+SCHED_CHECK = "SCHED_CHECK"  # cooperative schedule-exploration checker (tools/hvdsched)
+SCHED_SEED = "SCHED_SEED"  # base PRNG seed for hvdsched schedule choices
+SCHED_SCHEDULES = "SCHED_SCHEDULES"  # schedule budget per hvdsched exploration
 SPARK_START_TIMEOUT = "SPARK_START_TIMEOUT"  # spark barrier-task scheduling bound
 START_TIMEOUT = "START_TIMEOUT"  # programmatic run() worker startup bound
 FAULT_SPEC = "FAULT_SPEC"  # deterministic fault-injection spec (tests/chaos)

@@ -40,11 +40,25 @@ Violations raise by default (``raise_on_violation``) AND are counted;
 :func:`report` returns the counters so stress tests can assert "zero
 invariant reports" even where an exception would be swallowed by a
 daemon loop.
+
+**The hvdsched seam** (``HVD_SCHED_CHECK=1``, docs/schedule_checker.md):
+this module is also where the controlled-concurrency model checker
+(``tools/hvdsched``) plugs in. Under ``HVD_SCHED_CHECK=1`` the
+constructors return *cooperative* primitives driven by hvdsched's
+serializing scheduler, and the concurrency core additionally routes
+event creation (:func:`make_event`), thread creation
+(:func:`spawn_thread`), thread joins (:func:`join_thread`), sleeps
+(:func:`sleep`) and monotonic-clock reads (:func:`monotonic`) through
+here so the checker can serialize every interleaving point and run time
+on a virtual clock. With the knob unset each of those helpers is a thin
+alias for the plain :mod:`threading`/:mod:`time` call — the production
+code path is unchanged.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 
 from . import envs
@@ -80,7 +94,17 @@ def _env_enabled() -> bool:
     return envs.get_bool(envs.DEBUG_INVARIANTS)
 
 
-_ENABLED = _env_enabled()
+def _env_sched() -> bool:
+    return envs.get_bool(envs.SCHED_CHECK)
+
+
+# HVD_SCHED_CHECK supersedes HVD_DEBUG_INVARIANTS: under the
+# cooperative seam the constructors return hvdsched primitives, which
+# never register in the witness's held stack — leaving the assert
+# helpers armed would make every wired-in assert_holding fire
+# spuriously. hvdsched's own detectors cover the same failure class.
+_SCHED = _env_sched()
+_ENABLED = _env_enabled() and not _SCHED
 
 
 def enabled() -> bool:
@@ -88,11 +112,34 @@ def enabled() -> bool:
     return _ENABLED
 
 
+def sched_check() -> bool:
+    """Whether the hvdsched cooperative-scheduler seam is active
+    (cached; see :func:`refresh`)."""
+    return _SCHED
+
+
+def _sched_mod():
+    """The hvdsched primitive module (lazy: only imported when
+    ``HVD_SCHED_CHECK=1``, which only makes sense running from a repo
+    checkout where ``tools/`` is importable)."""
+    try:
+        from tools.hvdsched import primitives
+    except ImportError as e:  # pragma: no cover - mis-set env only
+        raise RuntimeError(
+            "HVD_SCHED_CHECK=1 requires the tools/hvdsched package "
+            "(run from the repo root with tools/ on sys.path); see "
+            "docs/schedule_checker.md") from e
+    return primitives
+
+
 def refresh() -> bool:
-    """Re-read ``HVD_DEBUG_INVARIANTS`` (tests toggle it after import).
-    Only affects primitives created afterwards and the assert helpers."""
-    global _ENABLED
-    _ENABLED = _env_enabled()
+    """Re-read ``HVD_DEBUG_INVARIANTS`` / ``HVD_SCHED_CHECK`` (tests
+    toggle them after import). Only affects primitives created
+    afterwards and the assert helpers. ``HVD_SCHED_CHECK`` supersedes
+    the witness (see the cached-flag comment above)."""
+    global _ENABLED, _SCHED
+    _SCHED = _env_sched()
+    _ENABLED = _env_enabled() and not _SCHED
     return _ENABLED
 
 
@@ -252,12 +299,17 @@ class _TrackedRLock(_TrackedLock):
 
 def make_lock(name: str):
     """A mutex for ``name`` — witness-tracked when the checker is on,
-    a plain ``threading.Lock`` otherwise. ``name`` convention:
-    ``module.owner.attr`` (e.g. ``fusion_cycle.scheduler.mu``)."""
+    cooperative under ``HVD_SCHED_CHECK=1``, a plain ``threading.Lock``
+    otherwise. ``name`` convention: ``module.owner.attr`` (e.g.
+    ``fusion_cycle.scheduler.mu``)."""
+    if _SCHED:
+        return _sched_mod().Lock(name)
     return _TrackedLock(name) if _ENABLED else threading.Lock()
 
 
 def make_rlock(name: str):
+    if _SCHED:
+        return _sched_mod().RLock(name)
     return _TrackedRLock(name) if _ENABLED else threading.RLock()
 
 
@@ -265,9 +317,68 @@ def make_condition(name: str):
     """A ``threading.Condition`` over a tracked mutex. ``wait()`` releases
     and re-acquires through the tracked lock, so held-lock state stays
     correct across waits."""
+    if _SCHED:
+        m = _sched_mod()
+        return m.Condition(m.Lock(name))
     if not _ENABLED:
         return threading.Condition(threading.Lock())
     return threading.Condition(_TrackedLock(name))
+
+
+def make_event(name: str):
+    """A ``threading.Event`` for ``name`` — cooperative under
+    ``HVD_SCHED_CHECK=1`` so hvdsched can serialize wait/set/clear
+    interleavings and run timed waits on the virtual clock; a plain
+    event otherwise (the witness does not track events)."""
+    if _SCHED:
+        return _sched_mod().Event(name)
+    return threading.Event()
+
+
+def spawn_thread(target, *, name: str, daemon: bool = True,
+                 args=(), kwargs=None) -> threading.Thread:
+    """Create AND start a thread. Under ``HVD_SCHED_CHECK=1`` a thread
+    spawned while an hvdsched model run is active registers with the
+    cooperative scheduler (it only runs when scheduled); outside a model
+    run — or with the knob unset — this is a plain daemon thread."""
+    if _SCHED:
+        return _sched_mod().spawn_thread(target, name=name, daemon=daemon,
+                                         args=args, kwargs=kwargs or {})
+    t = threading.Thread(target=target, name=name, daemon=daemon,
+                         args=args, kwargs=kwargs or {})
+    t.start()
+    return t
+
+
+def join_thread(thread: threading.Thread | None, timeout=None) -> None:
+    """``thread.join(timeout)``, cooperatively when both the joiner and
+    the target are hvdsched-managed (a real join on a parked managed
+    thread would hang the controlled schedule)."""
+    if thread is None:
+        return
+    if _SCHED:
+        _sched_mod().join_thread(thread, timeout)
+        return
+    thread.join(timeout)
+
+
+def sleep(seconds: float) -> None:
+    """``time.sleep`` routed through the virtual clock under an active
+    hvdsched model run (a real sleep would stall the serialized
+    schedule without creating any interleaving)."""
+    if _SCHED:
+        _sched_mod().sleep(seconds)
+        return
+    time.sleep(seconds)
+
+
+def monotonic() -> float:
+    """``time.monotonic`` from the hvdsched virtual clock under an
+    active model run, so deadline arithmetic (cycle pacing, retry
+    deadlines, beat aging) is deterministic and schedule-driven."""
+    if _SCHED:
+        return _sched_mod().monotonic()
+    return time.monotonic()
 
 
 def holding(lock) -> bool:

@@ -26,14 +26,16 @@ so a flapping transport is visible instead of silently absorbed.
 
 from __future__ import annotations
 
-import threading
-import time
 import zlib
 
 from . import envs
+from . import invariants as _inv
 from . import logging as hvd_logging
 
-_mu = threading.Lock()
+# Through the invariants seam so the hvdsched cooperative scheduler
+# (HVD_SCHED_CHECK) serializes the counter lock and runs the backoff
+# sleeps / poll pacing on the virtual clock (docs/schedule_checker.md).
+_mu = _inv.make_lock("retry.counters.mu")
 _counters: dict[str, dict[str, int]] = {}
 
 
@@ -99,7 +101,7 @@ def call(fn, *, what: str, retry_on=None, attempts: int | None = None,
     budget from the first call, not per attempt) would be exceeded by
     the next backoff."""
     n = attempts if attempts is not None else max_attempts()
-    end = None if deadline_s is None else time.monotonic() + deadline_s
+    end = None if deadline_s is None else _inv.monotonic() + deadline_s
     attempt = 0
     while True:
         attempt += 1
@@ -115,12 +117,12 @@ def call(fn, *, what: str, retry_on=None, attempts: int | None = None,
             delay = backoff_s(what, attempt)
             if (not retryable or attempt >= n
                     or (end is not None
-                        and time.monotonic() + delay > end)):
+                        and _inv.monotonic() + delay > end)):
                 if retryable:
                     _note(what, "giveups")
                 raise
             _record_retry(what, attempt, exc)
-            time.sleep(delay)
+            _inv.sleep(delay)
 
 
 def poll_intervals(what: str, *, interval_s: float,
@@ -132,7 +134,7 @@ def poll_intervals(what: str, *, interval_s: float,
     The interval backs off by 1.5x per yield up to ``max_interval_s``
     (default 8x the base) — a long wait shouldn't keep hammering the
     server at the initial rate."""
-    end = None if deadline_s is None else time.monotonic() + deadline_s
+    end = None if deadline_s is None else _inv.monotonic() + deadline_s
     cap = max_interval_s if max_interval_s is not None else 8.0 * interval_s
     cur = interval_s
     attempt = 0
@@ -140,10 +142,10 @@ def poll_intervals(what: str, *, interval_s: float,
         attempt += 1
         delay = cur * _jitter_factor(what, attempt)
         if end is not None:
-            remaining = end - time.monotonic()
+            remaining = end - _inv.monotonic()
             if remaining <= 0:
                 return
             delay = min(delay, remaining)
-        time.sleep(delay)
+        _inv.sleep(delay)
         yield attempt
         cur = min(cur * 1.5, cap)

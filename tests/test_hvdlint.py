@@ -564,6 +564,103 @@ class TestSilentExcept:
 
 
 # ---------------------------------------------------------------------------
+# rank-divergence
+# ---------------------------------------------------------------------------
+
+
+class TestRankDivergence:
+    def test_trips_on_rank_conditioned_submission(self, tmp_path):
+        src = """
+            from ..core import rank
+
+            def broadcast_params(h):
+                if rank() == 0:
+                    h.allreduce_async([1.0], name="params")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        assert len(found) == 1
+        assert "allreduce_async" in found[0].message
+        assert "rank()" in found[0].message
+
+    def test_trips_on_tainted_local_and_wallclock(self, tmp_path):
+        src = """
+            import time
+            from ..core import local_rank
+
+            def flush(sched, entry):
+                me = local_rank()
+                if me < 2:
+                    sched.flush_entry(entry)
+
+            def timed(sched, entry):
+                while time.monotonic() < 5.0:
+                    sched.flush_entry(entry)
+
+            def seam_clock(sched, entry, _inv):
+                if _inv.monotonic() > 1.0:
+                    sched.flush_entry(entry)
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 3
+        assert "me (from local_rank())" in msgs
+        assert "time.monotonic() (wall clock)" in msgs
+        assert "_inv.monotonic() (wall clock)" in msgs  # the seam alias
+
+    def test_trips_on_set_iteration_order(self, tmp_path):
+        src = """
+            def submit_all(svc, names):
+                pending = set(names)
+                for n in pending:
+                    svc.negotiate_many_submit([n])
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        assert len(found) == 1
+        assert "unordered set" in found[0].message
+
+    def test_rank_symmetric_conditionals_pass(self, tmp_path):
+        # every rank evaluates the same test the same way: no divergence
+        src = """
+            def bcast(h, root_rank, tensors):
+                if root_rank is not None:
+                    h.broadcast_async(tensors, root_rank)
+
+            def drain(sched, entries):
+                for e in sorted(entries):
+                    sched.flush_entry(e)
+
+            def guarded(h, enabled):
+                if enabled:
+                    h.allreduce_async([1.0], name="x")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
+    def test_rank_read_without_control_flow_passes(self, tmp_path):
+        # using rank() as a VALUE is fine; only branching on it diverges
+        src = """
+            from ..core import rank
+
+            def tagged(h):
+                h.allreduce_async([1.0], name=f"grad.{rank()}")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        src = """
+            from ..core import rank
+
+            def vetted(h):
+                if rank() == 0:
+                    # out-of-band agreement: every rank knows rank 0 submits
+                    h.allreduce_async([1.0])  # hvdlint: disable=rank-divergence
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # the real tree + CLI contract
 # ---------------------------------------------------------------------------
 
@@ -603,4 +700,38 @@ class TestRepoGate:
     def test_every_pass_registered(self):
         from tools.hvdlint import PASSES
         assert list(PASSES) == ["issue-lock", "lock-order", "timer-purity",
-                                "knob-registry", "donation", "silent-except"]
+                                "knob-registry", "donation", "silent-except",
+                                "rank-divergence"]
+
+    def test_cli_json_report(self, tmp_path):
+        import json as _json
+        from tools.hvdlint import PASSES
+
+        clean = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "horovod_tpu",
+             "--json"],
+            cwd=REPO_ROOT, capture_output=True, text=True)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        doc = _json.loads(clean.stdout)
+        assert doc["clean"] is True and doc["findings"] == []
+        assert [p["name"] for p in doc["passes"]] == list(PASSES)
+        assert all(p["seconds"] >= 0 for p in doc["passes"])
+
+        make_project(tmp_path, {"bad.py": """
+            import os
+
+            def read():
+                return os.environ.get("HVD_X")
+        """})
+        dirty = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "pkg", "--json"],
+            cwd=tmp_path, env={"PYTHONPATH": str(REPO_ROOT),
+                               "PATH": "/usr/bin:/bin"},
+            capture_output=True, text=True)
+        assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+        doc = _json.loads(dirty.stdout)
+        assert doc["clean"] is False
+        rec = doc["findings"][0]
+        assert rec["pass"] == "knob-registry"
+        assert rec["file"] == "pkg/ops/bad.py" and rec["line"] > 0
+        assert "message" in rec

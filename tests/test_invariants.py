@@ -36,6 +36,23 @@ def debug_invariants():
 
 
 @pytest.fixture
+def sched_check():
+    """Route the constructors through the hvdsched cooperative scheduler
+    for one test (mirrors the debug_invariants fixture; the two knobs
+    are exercised sequentially — under HVD_SCHED_CHECK the cooperative
+    primitives take precedence over the witness's tracked ones)."""
+    prior = os.environ.get("HVD_SCHED_CHECK")
+    os.environ["HVD_SCHED_CHECK"] = "1"
+    inv.refresh()
+    yield inv
+    if prior is None:
+        os.environ.pop("HVD_SCHED_CHECK", None)
+    else:
+        os.environ["HVD_SCHED_CHECK"] = prior
+    inv.refresh()
+
+
+@pytest.fixture
 def checker_disabled():
     """Force the cached enabled flag off without touching the
     environment (the flag is what every assert site reads)."""
@@ -251,6 +268,130 @@ class TestReentrancyGuard:
             t.start()
             t.join()
         assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# agreement with the hvdsched schedule checker (docs/schedule_checker.md)
+# ---------------------------------------------------------------------------
+
+
+def _inversion(a_name: str, b_name: str):
+    """The canonical two-lock inversion, built through whatever the
+    constructors currently return (tracked under HVD_DEBUG_INVARIANTS,
+    cooperative under HVD_SCHED_CHECK)."""
+    a = inv.make_lock(a_name)
+    b = inv.make_lock(b_name)
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    return ab, ba
+
+
+class TestHvdschedAgreement:
+    """The lock-order witness (this module) and hvdsched (the schedule
+    explorer) are two detectors for the same bug class; on the same
+    seeded inversion they must agree: the identical lock-order edge,
+    each reported with both participating stacks."""
+
+    EDGE = ("agree.a", "agree.b")
+
+    def test_same_inversion_same_edge_both_stacks(self, debug_invariants):
+        from tools.hvdsched import SchedFailure, explore
+
+        # -- detector 1: the witness, on the OS schedule ----------------
+        ab, ba = _inversion(*self.EDGE)
+        ab()
+        with pytest.raises(inv.InvariantViolation) as witness_exc:
+            ba()
+        witness_msg = str(witness_exc.value)
+        assert "agree.a -> agree.b" in witness_msg
+        assert "earlier acquisition" in witness_msg  # stack 1
+        assert "current acquisition" in witness_msg  # stack 2
+
+        # -- detector 2: hvdsched, owning the schedule ------------------
+        prior = os.environ.get("HVD_SCHED_CHECK")
+        os.environ["HVD_SCHED_CHECK"] = "1"
+        inv.refresh()
+        try:
+            def model():
+                m_ab, m_ba = _inversion(*self.EDGE)
+                t1 = inv.spawn_thread(m_ab, name="t-ab")
+                t2 = inv.spawn_thread(m_ba, name="t-ba")
+                inv.join_thread(t1)
+                inv.join_thread(t2)
+
+            result = explore(model, schedules=60, seed=0)
+        finally:
+            if prior is None:
+                os.environ.pop("HVD_SCHED_CHECK", None)
+            else:
+                os.environ["HVD_SCHED_CHECK"] = prior
+            inv.refresh()
+        assert not result.ok, "hvdsched missed the inversion the witness saw"
+        finding = result.findings[0]
+        assert isinstance(finding, SchedFailure)
+        assert finding.kind == "deadlock"
+        report = str(finding)
+        # the same edge, by name, with both blocked tasks' stacks
+        assert "agree.a" in report and "agree.b" in report
+        assert "t-ab" in report and "t-ba" in report
+        assert ", in ab" in report and ", in ba" in report  # a stack each
+
+    def test_sched_check_supersedes_witness(self, debug_invariants):
+        """With both knobs set, the constructors return cooperative
+        primitives that never register in the witness's held stack —
+        the assert helpers must disarm rather than fire spuriously on
+        every wired-in assert_holding."""
+        from tools.hvdsched import primitives
+
+        prior = os.environ.get("HVD_SCHED_CHECK")
+        os.environ["HVD_SCHED_CHECK"] = "1"
+        inv.refresh()
+        try:
+            assert not inv.enabled()
+            mu = inv.make_lock("both.mu")
+            assert isinstance(mu, primitives.Lock)
+            with mu:
+                inv.assert_holding(mu, "guarded mutation")  # no-op, no raise
+            inv.assert_holding(mu, "unguarded too")  # still a no-op
+        finally:
+            if prior is None:
+                os.environ.pop("HVD_SCHED_CHECK", None)
+            else:
+                os.environ["HVD_SCHED_CHECK"] = prior
+            inv.refresh()
+        assert inv.enabled()  # the witness re-arms once sched is off
+
+    def test_lost_wakeup_fixture_needs_exploration(self, sched_check):
+        """A missed-signal window (flag checked outside the lock): the
+        witness has nothing to say (no lock-order edge, no affinity
+        breach) and the default schedule happens to pass — only schedule
+        exploration forces the failing interleaving. Uses the shared
+        canonical fixture so the shape lives in exactly one place."""
+        from tools.hvdsched import SchedFailure, explore, models, run_model
+
+        model = models.DEMOS["lost-wakeup-demo"]
+        run_model(model, seed=0)  # the default schedule is clean
+        result = explore(model, schedules=60, seed=0)
+        assert not result.ok, "exploration must force the missed signal"
+        finding = result.findings[0]
+        assert finding.kind == "lost-wakeup"
+        assert "demo.cv" in str(finding)
+        # the witness side of the agreement: no lock-order edge exists
+        # for it to record — the bug is invisible to HVD_DEBUG_INVARIANTS
+        # and the finding replays byte-for-byte from (seed, trace)
+        with pytest.raises(SchedFailure) as exc:
+            run_model(model, seed=finding.seed, trace=finding.trace)
+        assert exc.value.kind == "lost-wakeup"
+        assert exc.value.trace == finding.trace
 
 
 # ---------------------------------------------------------------------------

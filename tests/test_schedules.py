@@ -1,0 +1,253 @@
+"""hvdsched schedule-exploration model tests.
+
+The concurrency-core race matrix under controlled schedule exploration
+(every model must be clean), the detector suite against known-bad
+fixtures (every planted bug must be FOUND and must replay byte-for-byte
+from its ``(seed, trace)``), and the pinned PR-3 / PR-6 regression
+shapes: the unguarded variants reconstruct the two deadlocks those PRs
+fixed, the guarded variants run the current protections
+(``program_issue.issue_serialized``; result materialization before
+consumer chaining) and must survive exploration.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from horovod_tpu.utils import invariants as inv  # noqa: E402
+from tools.hvdsched import (  # noqa: E402
+    SchedFailure,
+    explore,
+    models,
+    run_model,
+)
+
+
+@pytest.fixture
+def sched_check():
+    """Route the invariants seam through the cooperative scheduler for
+    one test, restoring the prior cached state exactly (mirrors the
+    debug_invariants fixture in test_invariants.py). Also silences the
+    runtime logger: the models deliberately simulate failures, and
+    their ERROR lines are expected model output, not test noise."""
+    prior = os.environ.get("HVD_SCHED_CHECK")
+    os.environ["HVD_SCHED_CHECK"] = "1"
+    inv.refresh()
+    logger = logging.getLogger("horovod_tpu")
+    prior_level = logger.level
+    logger.setLevel(logging.CRITICAL)
+    yield inv
+    if prior is None:
+        os.environ.pop("HVD_SCHED_CHECK", None)
+    else:
+        os.environ["HVD_SCHED_CHECK"] = prior
+    inv.refresh()
+    logger.setLevel(prior_level)
+
+
+# ---------------------------------------------------------------------------
+# detectors + byte-for-byte replay
+# ---------------------------------------------------------------------------
+
+class TestDetectors:
+    def test_deadlock_found_named_and_replayed(self, sched_check):
+        result = explore(models.DEMOS["deadlock-demo"], schedules=60,
+                         seed=0)
+        assert not result.ok, result.summary()
+        f = result.findings[0]
+        assert f.kind == "deadlock"
+        # the report names both locks of the inversion and both tasks
+        text = str(f)
+        assert "demo.a" in text and "demo.b" in text
+        assert "t1" in text and "t2" in text
+        # byte-for-byte replay from (seed, trace)
+        with pytest.raises(SchedFailure) as exc:
+            run_model(models.DEMOS["deadlock-demo"], seed=f.seed,
+                      trace=f.trace)
+        f2 = exc.value
+        assert f2.kind == f.kind
+        assert f2.trace == f.trace
+        assert f2.report == f.report
+
+    def test_lost_wakeup_found_only_under_exploration(self, sched_check):
+        # the default schedule is clean — the missed-signal window
+        # needs a specific preemption that only exploration forces
+        run_model(models.DEMOS["lost-wakeup-demo"], seed=0)
+        result = explore(models.DEMOS["lost-wakeup-demo"], schedules=60,
+                         seed=0)
+        assert not result.ok
+        f = result.findings[0]
+        assert f.kind == "lost-wakeup"
+        assert "demo.cv" in str(f)
+        with pytest.raises(SchedFailure) as exc:
+            run_model(models.DEMOS["lost-wakeup-demo"], seed=f.seed,
+                      trace=f.trace)
+        assert exc.value.kind == "lost-wakeup"
+
+    def test_livelock_detector(self, sched_check):
+        def spin():
+            lock = inv.make_lock("spin.lock")
+            stop = []
+
+            def spinner():
+                while not stop:
+                    with lock:
+                        pass
+
+            t = inv.spawn_thread(spinner, name="spinner", daemon=False)
+            inv.join_thread(t)
+
+        with pytest.raises(SchedFailure) as exc:
+            run_model(spin, seed=0, max_steps=300)
+        assert exc.value.kind == "livelock"
+
+    def test_lock_leak_is_reported_not_masked(self, sched_check):
+        # exiting while holding a lock is a permanent deadlock in real
+        # threading; the runtime must flag it, not silently release
+        def leak():
+            lock = inv.make_lock("leak.lock")
+
+            def holder():
+                lock.acquire()  # BUG: never released
+
+            t = inv.spawn_thread(holder, name="holder")
+            inv.join_thread(t)
+
+        with pytest.raises(SchedFailure) as exc:
+            run_model(leak, seed=0)
+        assert exc.value.kind == "lock-leak"
+        assert "leak.lock" in str(exc.value)
+
+    def test_model_exception_propagates(self, sched_check):
+        def boom():
+            raise ValueError("model bug, not a schedule finding")
+
+        with pytest.raises(ValueError, match="model bug"):
+            run_model(boom, seed=0)
+
+    def test_model_assertion_becomes_replayable_finding(self, sched_check):
+        # a model CONTRACT assertion is a schedule finding: it must
+        # carry (seed, trace) so the explorer/CI gate can replay it,
+        # unlike an arbitrary exception (a bug in the model itself)
+        def broken_contract():
+            ev = inv.make_event("contract.ev")
+            if not ev.wait(5.0):  # nobody ever sets it
+                raise AssertionError("entry never settled")
+
+        with pytest.raises(SchedFailure) as exc:
+            run_model(broken_contract, seed=7)
+        assert exc.value.kind == "model-assertion"
+        assert "entry never settled" in str(exc.value)
+        assert exc.value.seed == 7
+        # and it replays byte-for-byte
+        with pytest.raises(SchedFailure) as exc2:
+            run_model(broken_contract, seed=exc.value.seed,
+                      trace=exc.value.trace)
+        assert exc2.value.kind == "model-assertion"
+        assert exc2.value.trace == exc.value.trace
+
+    def test_virtual_clock_runs_fast(self, sched_check):
+        # 1000 virtual seconds of sleeping must not take wall time
+        def sleeper():
+            inv.sleep(500.0)
+            inv.sleep(500.0)
+
+        res = run_model(sleeper, seed=0)
+        assert res.clock >= 1000.0
+
+    def test_seeded_runs_are_deterministic(self, sched_check):
+        r1 = run_model(models.MATRIX["pr6-chain-guard"], seed=11)
+        r2 = run_model(models.MATRIX["pr6-chain-guard"], seed=11)
+        assert r1.trace == r2.trace
+
+
+# ---------------------------------------------------------------------------
+# the clean race matrix
+# ---------------------------------------------------------------------------
+
+class TestRaceMatrix:
+    @pytest.mark.parametrize("name", sorted(models.MATRIX))
+    def test_matrix_model_clean_under_exploration(self, sched_check, name):
+        result = explore(models.MATRIX[name], schedules=25, seed=0)
+        assert result.ok, (
+            f"{name} should be schedule-clean, found:\n"
+            + str(result.findings[0]))
+        assert result.runs == 25
+
+
+# ---------------------------------------------------------------------------
+# pinned PR-3 / PR-6 regression shapes
+# ---------------------------------------------------------------------------
+
+class TestPinnedRegressions:
+    def test_pr3_rendezvous_interleaving(self, sched_check):
+        """The PR-3 shape: interleaved multi-device program launches
+        cross the device queues and deadlock the rendezvous. Unguarded
+        must be found; the real issue_serialized guard must hold."""
+        bad = explore(models.DEMOS["pr3-unguarded"], schedules=60, seed=0)
+        assert not bad.ok, "PR-3 deadlock shape no longer reproduces"
+        f = bad.findings[0]
+        assert "rendezvous" in str(f)
+        with pytest.raises(SchedFailure):  # pinned replay
+            run_model(models.DEMOS["pr3-unguarded"], seed=f.seed,
+                      trace=f.trace)
+        good = explore(models.MATRIX["pr3-issue-lock"], schedules=40,
+                       seed=0)
+        assert good.ok, (
+            "program_issue.issue_serialized no longer prevents the PR-3 "
+            "rendezvous deadlock:\n" + str(good.findings[0]))
+
+    def test_pr6_chain_starvation(self, sched_check):
+        """The PR-6 shape: consumers chained on an in-flight chunked
+        collective occupy the execution pool and starve its remaining
+        chunks. Unguarded must be found; materialize-before-chain (the
+        HVD_EAGER_CHAIN auto-disable) must hold."""
+        bad = explore(models.DEMOS["pr6-unguarded"], schedules=60, seed=0)
+        assert not bad.ok, "PR-6 starvation shape no longer reproduces"
+        f = bad.findings[0]
+        assert "collective.result" in str(f)
+        with pytest.raises(SchedFailure):  # pinned replay
+            run_model(models.DEMOS["pr6-unguarded"], seed=f.seed,
+                      trace=f.trace)
+        good = explore(models.MATRIX["pr6-chain-guard"], schedules=40,
+                       seed=0)
+        assert good.ok, str(good.findings) if good.findings else ""
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def _run(self, *args):
+        env = dict(os.environ, HVD_SCHED_CHECK="1")
+        return subprocess.run(
+            [sys.executable, "-m", "tools.hvdsched", *args],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=300)
+
+    def test_list(self):
+        proc = self._run("--list")
+        assert proc.returncode == 0, proc.stderr
+        assert "pr3-issue-lock [matrix]" in proc.stdout
+        assert "deadlock-demo [demo]" in proc.stdout
+
+    def test_demo_gate_finds_planted_bug(self):
+        proc = self._run("--demos", "--model", "deadlock-demo",
+                         "--schedules", "40")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "FOUND" in proc.stdout
+        assert "seed=" in proc.stdout and "trace=" in proc.stdout
+
+    def test_unknown_model_is_usage_error(self):
+        proc = self._run("--model", "no-such-model")
+        assert proc.returncode == 2

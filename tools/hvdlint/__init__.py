@@ -1,38 +1,47 @@
 """hvdlint: project-invariant static analysis for the horovod_tpu runtime.
 
-Six AST passes, each encoding a concurrency/determinism invariant that a
-PR introduced and a future regression would break silently (a hang or a
-cross-rank divergence, not a test failure):
+Seven AST passes, each encoding a concurrency/determinism invariant that
+a PR introduced and a future regression would break silently (a hang or
+a cross-rank divergence, not a test failure):
 
-=============  ==============================================================
-pass           invariant (provenance)
-=============  ==============================================================
-issue-lock     compiled eager collectives enqueue under the program-issue
-               lock (PR 3's reproduced XLA rendezvous deadlock)
-lock-order     the static ``with``-nesting graph across modules is acyclic
-               (the documented one-way ``_mu -> _exec_cv`` convention)
-timer-purity   nothing reachable from the cycle timer reads wall clocks,
-               randomness, negotiates, or iterates sets into batch order
-               (PR 2-3's rank-deterministic flush composition contract)
-knob-registry  every HVD_* knob flows through utils/envs.py and round-trips
-               with docs/knobs.md + the autotune tunables (PR 1's
-               override-epoch invalidation)
-donation       a donated buffer is never referenced after the donating call
-               (PR 1's aliasing rules; CPU tests cannot catch this)
-silent-except  broad ``except: pass`` handlers and hand-rolled
-               ``time.sleep`` retry loops route failures around the
-               failure domain (PR 5's retry/watchdog machinery)
-=============  ==============================================================
+===============  ============================================================
+pass             invariant (provenance)
+===============  ============================================================
+issue-lock       compiled eager collectives enqueue under the program-issue
+                 lock (PR 3's reproduced XLA rendezvous deadlock)
+lock-order       the static ``with``-nesting graph across modules is acyclic
+                 (the documented one-way ``_mu -> _exec_cv`` convention)
+timer-purity     nothing reachable from the cycle timer reads wall clocks,
+                 randomness, negotiates, or iterates sets into batch order
+                 (PR 2-3's rank-deterministic flush composition contract)
+knob-registry    every HVD_* knob flows through utils/envs.py and
+                 round-trips with docs/knobs.md + the autotune tunables
+                 (PR 1's override-epoch invalidation)
+donation         a donated buffer is never referenced after the donating
+                 call (PR 1's aliasing rules; CPU tests cannot catch this)
+silent-except    broad ``except: pass`` handlers and hand-rolled
+                 ``time.sleep`` retry loops route failures around the
+                 failure domain (PR 5's retry/watchdog machinery)
+rank-divergence  collective submissions (``*_async`` / ``flush_entry`` /
+                 ``negotiate_many_submit``) never sit under rank-local
+                 control flow — rank comparisons, wall-clock tests, set
+                 iteration (the mismatched-collective hang class)
+===============  ============================================================
 
 Run ``python -m tools.hvdlint horovod_tpu`` from the repo root; findings
-print as ``file:line: [pass] message`` and a nonzero exit fails CI.
-Suppress a vetted exception inline with ``# hvdlint: disable=<pass>``.
-Full catalog: docs/static_analysis.md. The dynamic counterpart is the
-``HVD_DEBUG_INVARIANTS=1`` runtime checker
-(``horovod_tpu/utils/invariants.py``).
+print as ``file:line: [pass] message`` and a nonzero exit fails CI
+(``--json`` emits the same findings as structured records plus per-pass
+timing). Suppress a vetted exception inline with
+``# hvdlint: disable=<pass>``. Full catalog: docs/static_analysis.md.
+The dynamic counterparts are the ``HVD_DEBUG_INVARIANTS=1`` runtime
+checker (``horovod_tpu/utils/invariants.py``) and the
+``HVD_SCHED_CHECK=1`` schedule-exploration checker (``tools/hvdsched``,
+docs/schedule_checker.md).
 """
 
 from __future__ import annotations
+
+import time
 
 from .core import Finding, Project
 from .passes import PASSES
@@ -40,10 +49,12 @@ from .passes import PASSES
 __all__ = ["Finding", "PASSES", "Project", "run_all"]
 
 
-def run_all(project: Project, only: list[str] | None = None
-            ) -> list[Finding]:
+def run_all(project: Project, only: list[str] | None = None,
+            timings: dict[str, float] | None = None) -> list[Finding]:
     """Run the suite (or the ``only`` subset) and return deduplicated
-    findings in (path, line) order."""
+    findings in (path, line) order. When ``timings`` is a dict, each
+    pass's wall seconds are accumulated into it (the ``--json`` report
+    and the CI annotation step surface them)."""
     names = list(PASSES) if not only else only
     out: list[Finding] = []
     seen: set[Finding] = set()
@@ -51,9 +62,13 @@ def run_all(project: Project, only: list[str] | None = None
         if name not in PASSES:
             raise KeyError(f"unknown hvdlint pass {name!r}; "
                            f"available: {', '.join(PASSES)}")
+        t0 = time.perf_counter()
         for f in PASSES[name](project):
             if f not in seen:
                 seen.add(f)
                 out.append(f)
+        if timings is not None:
+            timings[name] = (timings.get(name, 0.0)
+                             + time.perf_counter() - t0)
     out.sort(key=lambda f: (f.path, f.line, f.pass_name))
     return out

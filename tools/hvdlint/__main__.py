@@ -1,14 +1,18 @@
-"""CLI: ``python -m tools.hvdlint <package-dir> [--pass NAME]... [--list]``.
+"""CLI: ``python -m tools.hvdlint <package-dir> [--pass NAME]...
+[--json] [--list]``.
 
 Exit status: 0 = clean, 1 = findings, 2 = usage error. The package
 argument is the path to the analyzed package relative to the repo root
 (normally ``horovod_tpu``); docs are resolved as ``docs/knobs.md``
-next to it.
+next to it. ``--json`` replaces the line-per-finding output with one
+JSON document — ``{file, line, pass, message}`` records plus per-pass
+wall-time — for structured consumers (the ci.sh annotation step).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -27,6 +31,9 @@ def main(argv=None) -> int:
                         metavar="NAME",
                         help="run only this pass (repeatable); "
                              "default: all")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON report (findings + per-pass "
+                             "timing) instead of text lines")
     parser.add_argument("--list", action="store_true",
                         help="list available passes and exit")
     args = parser.parse_args(argv)
@@ -45,20 +52,40 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     project = Project(root, package_rel=pkg.name)
+    timings: dict[str, float] = {}
     try:
-        findings = run_all(project, args.passes)
+        findings = run_all(project, args.passes, timings=timings)
     except KeyError as e:
         print(f"hvdlint: {e.args[0]}", file=sys.stderr)
         return 2
+    n_files = len(project.files)
+    ran_names = args.passes if args.passes else list(PASSES)
+    if args.json:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.pass_name] = counts.get(f.pass_name, 0) + 1
+        print(json.dumps({
+            "tool": "hvdlint",
+            "package": str(pkg),
+            "files": n_files,
+            "clean": not findings,
+            "findings": [{"file": f.path, "line": f.line,
+                          "pass": f.pass_name, "message": f.message}
+                         for f in findings],
+            "passes": [{"name": name,
+                        "seconds": round(timings.get(name, 0.0), 4),
+                        "findings": counts.get(name, 0)}
+                       for name in ran_names],
+        }, indent=2))
+        return 1 if findings else 0
     for f in findings:
         print(f.format())
-    n_files = len(project.files)
     if findings:
         print(f"hvdlint: {len(findings)} finding(s) across {n_files} "
               "file(s)", file=sys.stderr)
         return 1
-    ran = ", ".join(args.passes) if args.passes else ", ".join(PASSES)
-    print(f"hvdlint: clean ({n_files} files; passes: {ran})")
+    print(f"hvdlint: clean ({n_files} files; passes: "
+          f"{', '.join(ran_names)})")
     return 0
 
 

@@ -7,6 +7,7 @@ from . import (
     issue_lock,
     knob_registry,
     lock_order,
+    rank_divergence,
     silent_except,
     timer_purity,
 )
@@ -20,4 +21,5 @@ PASSES = {
     knob_registry.NAME: knob_registry.run,
     donation.NAME: donation.run,
     silent_except.NAME: silent_except.run,
+    rank_divergence.NAME: rank_divergence.run,
 }

@@ -1,0 +1,181 @@
+"""rank-divergence pass: collective submissions must not sit under
+rank-local control flow.
+
+Invariant (the reference coordinator's first rule, PAPER.md; PR-2's
+determinism contract): every process must submit the SAME sequence of
+collectives. A collective enqueue/flush reachable only under a
+condition whose value differs per rank — ``rank()`` / ``local_rank()``
+/ ``cross_rank()`` comparisons, wall-clock reads, or iteration order of
+an unordered ``set`` — is the classic mismatched-collective hang: rank
+0 calls ``allreduce_async`` inside ``if rank() == 0:`` and every other
+rank waits forever for a negotiation that will never complete (the
+stall inspector names it after 60 s; the job is already dead).
+
+*Checked:* call sites of the submission surface — any ``*_async`` call,
+``flush_entry``, or ``negotiate_many_submit`` — lexically inside the
+body/orelse of an ``if``/``while``/ternary whose test is **rank-local**
+(contains a rank-family or wall-clock call, or a local name assigned
+from one), or inside a ``for`` over an obvious ``set`` value (unordered
+iteration diverges submission *order* across ranks even when the call
+count matches).
+
+Rank-symmetric conditionals are fine and common (``root_rank``
+dispatch where every rank takes the same branch is NOT flagged — the
+test must reference a rank-local value). A vetted divergence —
+e.g. a site guarded by an out-of-band agreement — carries
+``# hvdlint: disable=rank-divergence`` with a justification, like every
+other pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, dotted_name, parent_map
+
+NAME = "rank-divergence"
+
+_RANK_CALLS = {"rank", "local_rank", "cross_rank"}
+_WALLCLOCK = {"time.time", "time.time_ns", "time.monotonic",
+              "time.perf_counter", "datetime.now", "datetime.datetime.now",
+              "datetime.utcnow", "datetime.datetime.utcnow"}
+# clocks the concurrency core reads through the invariants seam
+# (utils/invariants.monotonic and its _inv/primitives aliases): matched
+# by last segment, since the package never spells time.monotonic raw
+_WALLCLOCK_LAST = {"monotonic", "perf_counter"}
+_SUBMIT_NAMES = {"flush_entry", "negotiate_many_submit"}
+
+
+def _taint_call(node: ast.AST) -> str | None:
+    """The offending source when ``node`` is a rank-local call."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    if last in _RANK_CALLS:
+        return f"{name}()"
+    if name in _WALLCLOCK or last in _WALLCLOCK_LAST:
+        return f"{name}() (wall clock)"
+    return None
+
+
+def _expr_taint(expr: ast.AST, tainted: dict[str, str]) -> str | None:
+    for node in ast.walk(expr):
+        why = _taint_call(node)
+        if why is not None:
+            return why
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return tainted[node.id]
+    return None
+
+
+def _tainted_names(fn: ast.AST) -> dict[str, str]:
+    """Local names (transitively) assigned from rank-local values,
+    mapped to the original source for the message."""
+    tainted: dict[str, str] = {}
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            value = None
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                value, targets = node.value, [node.target]
+            if value is None:
+                continue
+            why = _expr_taint(value, tainted)
+            if why is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id not in tainted:
+                    tainted[t.id] = f"{t.id} (from {why})"
+                    changed = True
+    return tainted
+
+
+def _submission_call(node: ast.AST) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    if last.endswith("_async") or last in _SUBMIT_NAMES:
+        return name
+    return None
+
+
+def _is_set_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id in ("set", "frozenset"))
+
+
+def _set_typed_names(fn: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        parents = parent_map(sf.tree)
+        funcs = [n for n in ast.walk(sf.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and not isinstance(parents.get(n),
+                                    (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            tainted = _tainted_names(fn)
+            set_names = _set_typed_names(fn)
+            for node in ast.walk(fn):
+                call_name = _submission_call(node)
+                if call_name is None or sf.suppressed(NAME, node.lineno):
+                    continue
+                cur = node
+                while cur is not fn:
+                    parent = parents.get(cur)
+                    if parent is None:
+                        break
+                    why = _guard_taint(parent, cur, tainted, set_names)
+                    if why is not None:
+                        findings.append(Finding(
+                            NAME, sf.rel, node.lineno,
+                            f"collective submission '{call_name}' under "
+                            f"rank-local control flow ({why}): every rank "
+                            "must submit the identical collective "
+                            "sequence — a rank-conditioned enqueue/flush "
+                            "hangs the peers (mismatched collectives). "
+                            "Hoist the call, or pragma a vetted "
+                            "exception"))
+                        break
+                    cur = parent
+    return findings
+
+
+def _guard_taint(parent: ast.AST, child: ast.AST, tainted: dict,
+                 set_names: set[str]) -> str | None:
+    """Why ``child``'s position under ``parent`` is rank-divergent."""
+    if isinstance(parent, (ast.If, ast.While)):
+        if child in parent.body or child in parent.orelse:
+            return _expr_taint(parent.test, tainted)
+    elif isinstance(parent, ast.IfExp):
+        if child is parent.body or child is parent.orelse:
+            return _expr_taint(parent.test, tainted)
+    elif isinstance(parent, ast.For):
+        if child in parent.body:
+            src = parent.iter
+            if (_is_set_expr(src)
+                    or (isinstance(src, ast.Name) and src.id in set_names)):
+                return "iteration over an unordered set"
+    return None

@@ -1,0 +1,38 @@
+"""hvdsched: deterministic schedule-exploration checker for the
+concurrency core.
+
+The dynamic counterpart to ``tools/hvdlint`` and the third leg of the
+project's concurrency tooling (docs/schedule_checker.md):
+
+* ``hvdlint`` checks the *lexical* shape of the concurrency invariants;
+* ``HVD_DEBUG_INVARIANTS=1`` (``utils/invariants.py``) witnesses what
+  threads *did* on whatever schedule the OS happened to pick;
+* ``HVD_SCHED_CHECK=1`` + hvdsched takes control of the schedule
+  itself: every lock/condition/event/thread/sleep in the concurrency
+  core routes through a cooperative scheduler that serializes all
+  threads to ONE runnable at a time and drives every interleaving
+  choice from a seeded PRNG — so the schedule space can be *explored*
+  (seed sweeps + DPOR-lite preemption branching) and any failing
+  schedule replays byte-for-byte from ``(seed, trace)``.
+
+Usage::
+
+    HVD_SCHED_CHECK=1 python -m tools.hvdsched                # matrix gate
+    HVD_SCHED_CHECK=1 python -m tools.hvdsched --demos        # detector sanity
+    HVD_SCHED_CHECK=1 python -m tools.hvdsched --model flush-abort \
+        --schedules 500
+
+or from tests::
+
+    from tools.hvdsched import explore, run_model, models
+    result = explore(models.MATRIX["flush-abort"], schedules=200)
+    assert result.ok, result.findings[0]
+"""
+
+from __future__ import annotations
+
+from .explore import ExploreResult, explore, run_model
+from .runtime import Result, Runtime, SchedError, SchedExit, SchedFailure
+
+__all__ = ["ExploreResult", "Result", "Runtime", "SchedError", "SchedExit",
+           "SchedFailure", "explore", "run_model"]

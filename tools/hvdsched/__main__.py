@@ -1,0 +1,142 @@
+"""CLI: ``HVD_SCHED_CHECK=1 python -m tools.hvdsched [options]``.
+
+Default: explore every clean-matrix model (``models.MATRIX``) with the
+schedule budget split across them; any finding prints its full report
+plus the ``(seed, trace)`` replay line and exits 1. ``--demos`` runs
+the known-bad fixtures instead and exits 1 unless exploration FINDS
+every planted bug (detector sanity). ``--replay FILE`` re-runs one
+recorded schedule byte-for-byte from a JSON ``{model, seed, trace}``.
+
+Exit status: 0 = gate passed, 1 = findings (or a demo not found),
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _ensure_env() -> None:
+    """The cooperative seam must be active BEFORE any horovod_tpu
+    module creates primitives; the CLI owns its process, so it sets
+    the knob unconditionally (an exported HVD_SCHED_CHECK=0 would
+    otherwise silently run the models on real threads: the unguarded
+    demos then deadlock for real, and the matrix gate prints a
+    meaningless 'clean') and refreshes the cached flag."""
+    import os
+    os.environ["HVD_SCHED_CHECK"] = "1"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # models deliberately simulate failures (poison records, aborts);
+    # their ERROR logs are expected output, not gate noise
+    os.environ.setdefault("HVD_LOG_LEVEL", "fatal")
+    from horovod_tpu.utils import invariants
+    invariants.refresh()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvdsched",
+        description="deterministic schedule-exploration checker for the "
+                    "horovod_tpu concurrency core "
+                    "(docs/schedule_checker.md)")
+    parser.add_argument("--model", action="append", metavar="NAME",
+                        help="explore only this model (repeatable); "
+                             "default: the clean matrix")
+    parser.add_argument("--schedules", type=int, default=None,
+                        help="total schedule budget (default: "
+                             "HVD_SCHED_SCHEDULES or 200)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="base PRNG seed (default: HVD_SCHED_SEED "
+                             "or 0)")
+    parser.add_argument("--max-steps", type=int, default=20000,
+                        help="livelock bound per schedule")
+    parser.add_argument("--demos", action="store_true",
+                        help="run the known-bad fixtures; fail unless "
+                             "every planted bug is FOUND")
+    parser.add_argument("--replay", metavar="FILE",
+                        help="replay one schedule from a JSON file "
+                             "{model, seed, trace}")
+    parser.add_argument("--list", action="store_true",
+                        help="list models and exit")
+    args = parser.parse_args(argv)
+
+    _ensure_env()
+    from horovod_tpu.utils import envs
+
+    from . import SchedFailure, explore, run_model
+    from . import models as _models
+
+    if args.list:
+        for name in _models.MATRIX:
+            print(f"{name} [matrix]")
+        for name in _models.DEMOS:
+            print(f"{name} [demo]")
+        return 0
+
+    seed = (args.seed if args.seed is not None
+            else envs.get_int(envs.SCHED_SEED, 0))
+    budget = (args.schedules if args.schedules is not None
+              else envs.get_int(envs.SCHED_SCHEDULES, 200))
+
+    if args.replay:
+        with open(args.replay) as f:
+            rec = json.load(f)
+        fn = _models.MODELS.get(rec["model"])
+        if fn is None:
+            print(f"hvdsched: unknown model {rec['model']!r}",
+                  file=sys.stderr)
+            return 2
+        try:
+            run_model(fn, seed=int(rec["seed"]), trace=rec["trace"],
+                      max_steps=args.max_steps)
+        except SchedFailure as fail:
+            print(f"replay of {rec['model']!r} reproduced: {fail}")
+            return 1
+        print(f"replay of {rec['model']!r}: clean "
+              "(the recorded schedule no longer fails)")
+        return 0
+
+    pool = _models.DEMOS if args.demos else _models.MATRIX
+    if args.model:
+        unknown = [m for m in args.model if m not in _models.MODELS]
+        if unknown:
+            print(f"hvdsched: unknown model(s) {unknown}; --list shows "
+                  "the catalog", file=sys.stderr)
+            return 2
+        pool = {m: _models.MODELS[m] for m in args.model}
+
+    # ceil-divide: a --schedules budget is a floor for the run, so the
+    # per-model split must round up, never shave the total under it
+    per_model = max(-(-budget // max(len(pool), 1)), 1)
+    failed = False
+    for name, fn in pool.items():
+        t0 = time.perf_counter()
+        result = explore(fn, schedules=per_model, seed=seed,
+                         max_steps=args.max_steps)
+        dt = time.perf_counter() - t0
+        if args.demos:
+            found = not result.ok
+            print(f"{name}: planted bug "
+                  f"{'FOUND' if found else 'NOT FOUND'} — "
+                  f"{result.summary()} [{dt:.1f}s]")
+            if found:
+                f0 = result.findings[0]
+                print(f"  kind={f0.kind} seed={f0.seed} "
+                      f"trace={f0.trace!r}")
+            else:
+                failed = True
+        else:
+            print(f"{name}: {result.summary()} [{dt:.1f}s]")
+            for f0 in result.findings:
+                failed = True
+                print(f"--- {name} finding "
+                      f"(replay: --model {name} + seed/trace below)")
+                print(str(f0))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""hvdsched schedule exploration: seed sweeps + DPOR-lite branching.
+
+Two complementary strategies over a *model* (a zero-argument callable
+that builds fresh state and exercises the concurrency core; see
+``models.py``):
+
+1. **Seed sweep** — run the model under N distinct PRNG seeds. Cheap,
+   unbiased, and the strategy that finds "wide" races (many schedules
+   hit them).
+
+2. **Targeted preemption branching (DPOR-lite)** — from each clean
+   run's recorded decision points, re-run with the schedule *forced* to
+   diverge at one point: replay the decision prefix byte-for-byte, pick
+   a different runnable task there, then continue randomly from a seed
+   derived from (base seed, step, alternative). Branch points are
+   pruned with a dependence heuristic in the spirit of dynamic
+   partial-order reduction:
+
+   * an alternative whose pending operation touches a **different
+     primitive** than the chosen task's operation commutes with it —
+     flipping the order yields an equivalent schedule, so the branch is
+     skipped (counted in ``pruned``);
+   * conflicting branch points are **ranked** by whether the primitive
+     participates in the run's recorded acquisition-order edge graph
+     (the same held->acquired edges the ``HVD_DEBUG_INVARIANTS``
+     lock-order witness records): nested locks are where ordering bugs
+     live, so they are explored first; leaf primitives come after.
+
+Every failing schedule carries ``(seed, trace)``; feed them back to
+:func:`run_model` (or ``python -m tools.hvdsched --replay``) for a
+byte-for-byte reproduction.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import deque
+
+from .runtime import Runtime, SchedFailure
+
+_DEFAULT_MAX_STEPS = 20000
+
+
+def run_model(fn, *, seed: int = 0, trace=None,
+              max_steps: int = _DEFAULT_MAX_STEPS):
+    """One controlled run of ``fn``. Returns a ``Result`` on a clean
+    run; raises :class:`SchedFailure` (deadlock / lost-wakeup /
+    livelock / replay divergence) or the model's own exception."""
+    return Runtime(seed=seed, trace=trace, max_steps=max_steps).run(fn)
+
+
+class ExploreResult:
+    """Outcome of :func:`explore`: the findings (empty = clean) and the
+    exploration accounting."""
+
+    __slots__ = ("findings", "runs", "branch_points", "pruned", "swept")
+
+    def __init__(self):
+        self.findings: list[SchedFailure] = []
+        self.runs = 0
+        self.branch_points = 0
+        self.pruned = 0
+        self.swept = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def summary(self) -> str:
+        state = ("clean" if self.ok
+                 else f"{len(self.findings)} finding(s)")
+        return (f"{state} over {self.runs} schedule(s) "
+                f"({self.swept} seed-swept, {self.branch_points} branched, "
+                f"{self.pruned} pruned as equivalent)")
+
+
+def _derived_seed(seed: int, step: int, alt: int) -> int:
+    return zlib.crc32(f"{seed}:{step}:{alt}".encode()) & 0x7FFFFFFF
+
+
+def _branch_prefixes(result, seed: int, tried: set, stats: ExploreResult,
+                     min_step: int = 0):
+    """(priority, prefix, derived seed) candidates from one clean run's
+    decision points — one per conflicting alternative choice."""
+    out = []
+    for point in result.points:
+        step = point["step"]
+        if step < min_step:
+            continue
+        chosen = point["chosen"]
+        chosen_op = point["ops"].get(chosen)
+        chosen_res = chosen_op[1] if chosen_op else None
+        for alt in point["runnable"]:
+            if alt == chosen:
+                continue
+            alt_op = point["ops"].get(alt)
+            alt_res = alt_op[1] if alt_op else None
+            prefix = tuple(result.trace[:step]) + (alt,)
+            if prefix in tried:
+                continue
+            if (chosen_res is None or alt_res is None
+                    or chosen_res != alt_res):
+                # independent ops commute: an equivalent schedule
+                stats.pruned += 1
+                tried.add(prefix)
+                continue
+            tried.add(prefix)
+            in_edges = any(chosen_res in e for e in result.edges)
+            out.append((0 if in_edges else 1, list(prefix),
+                        _derived_seed(seed, step, alt)))
+    out.sort(key=lambda item: item[0])
+    return out
+
+
+def explore(fn, *, schedules: int = 200, seed: int = 0,
+            max_steps: int = _DEFAULT_MAX_STEPS,
+            stop_on_first: bool = True) -> ExploreResult:
+    """Sweep ``schedules`` total runs of ``fn``: half fresh seeds, half
+    targeted preemption branches off clean runs (the branch frontier is
+    drained first when it has work). Returns an :class:`ExploreResult`;
+    model contract assertions surface as replayable ``model-assertion``
+    findings (the runtime wraps them with ``(seed, trace)``), while
+    other model-body exceptions propagate (they are bugs in the model,
+    not schedule findings)."""
+    stats = ExploreResult()
+    tried: set = set()
+    frontier: deque = deque()
+    next_fresh = 0
+
+    def attempt(s, trace=None, branched=False):
+        stats.runs += 1
+        if branched:
+            stats.branch_points += 1
+        else:
+            stats.swept += 1
+        try:
+            return run_model(fn, seed=s, trace=trace, max_steps=max_steps)
+        except SchedFailure as f:
+            if f.kind == "replay-divergence" and branched:
+                # the forced prefix pushed the model somewhere the
+                # recorded run never went (e.g. a task finished
+                # earlier); not a bug, just an infeasible branch
+                return None
+            stats.findings.append(f)
+            return None
+
+    while stats.runs < schedules:
+        if frontier:
+            _prio, prefix, dseed = frontier.popleft()
+            res = attempt(dseed, trace=prefix, branched=True)
+            # branch only past the forced divergence: the shared prefix
+            # was already harvested by the run it came from
+            min_step = len(prefix)
+        else:
+            res = attempt(seed + next_fresh)
+            next_fresh += 1
+            min_step = 0
+        if stats.findings and stop_on_first:
+            break
+        if res is not None:
+            for item in _branch_prefixes(res, res.seed, tried, stats,
+                                         min_step=min_step):
+                frontier.append(item)
+    return stats

@@ -1,0 +1,408 @@
+"""hvdsched model library: the concurrency-core race matrix.
+
+Each model is a zero-argument callable that builds **fresh** state
+(its own ``FusionScheduler`` / ``HealthWatchdog``), drives a racy
+scenario through the real runtime code, asserts the user-visible
+contract (every waiter unblocks; every entry ends done with a result
+or an error), and tears its threads down. Models run under
+:func:`~.explore.run_model` / :func:`~.explore.explore` with
+``HVD_SCHED_CHECK=1`` active *before* the model constructs its state,
+so every lock/condition/event/thread/sleep routes through the
+cooperative scheduler.
+
+Two registries:
+
+* ``MATRIX`` — the in-tree code must survive exploration of these with
+  **zero** findings; ``ci.sh`` sweeps them (the schedule-exploration
+  gate). They cover scheduler-enqueue x executor-flush x ``abort()`` x
+  watchdog-poison x ``flush_all`` quiesce, plus the guarded PR-3/PR-6
+  shapes running against the current protections.
+* ``DEMOS`` — known-bad fixtures that exploration MUST flag (detector
+  sanity, pinned regression traces): a lock inversion, a missed-signal
+  lost wakeup, and the PR-3/PR-6 deadlock shapes with their guards
+  removed.
+"""
+
+from __future__ import annotations
+
+NDEVICES = 2  # virtual devices in the rendezvous models
+
+
+def _fusion():
+    from horovod_tpu.ops import fusion_cycle
+    return fusion_cycle
+
+
+def _inv():
+    from horovod_tpu.utils import invariants
+    return invariants
+
+
+def _opaque(fc, name, value=None, fail=None):
+    def run():
+        if fail is not None:
+            raise fail
+        return value if value is not None else name
+    return fc._Entry([None], False, 0, [name], run=run, label=name)
+
+
+def _sparse_spec(fc):
+    return fc._QueueSpec("sparse", None, None, svc=None)
+
+
+def _assert_settled(entries) -> None:
+    for e in entries:
+        if not e.event.wait(30.0):
+            raise AssertionError(
+                f"entry {e.label!r} never settled (event unset)")
+        if e.error is None and e.results is None:
+            raise AssertionError(
+                f"entry {e.label!r} settled with neither results nor error")
+
+
+# ---------------------------------------------------------------------------
+# the clean race matrix (must explore with zero findings)
+# ---------------------------------------------------------------------------
+
+def enqueue_flush_quiesce():
+    """Two producers enqueue + threshold-flush against the pipelined
+    executor and the cycle timer; a flush_all drains; quiesce must
+    leave every entry dispatched."""
+    inv, fc = _inv(), _fusion()
+    sched = fc.FusionScheduler()
+    entries: list = []
+
+    def producer(i):
+        spec = _sparse_spec(fc)
+        for j in range(2):
+            e = _opaque(fc, f"p{i}.{j}", value=(i, j))
+            entries.append(e)
+            sched.enqueue(("sparse", f"k{i}"), spec, e)
+        sched.flush_queue(("sparse", f"k{i}"), "threshold")
+
+    t1 = inv.spawn_thread(producer, name="prod-1", args=(1,))
+    t2 = inv.spawn_thread(producer, name="prod-2", args=(2,))
+    inv.join_thread(t1)
+    inv.join_thread(t2)
+    sched.flush_all("barrier")
+    _assert_settled(entries)
+    for e in entries:
+        if e.error is not None:
+            raise AssertionError(f"clean flush errored: {e.error!r}")
+    sched.stop()
+
+
+def flush_abort_race():
+    """abort() racing producers and the executor: every entry must
+    settle (result if its flush won the race, abort error otherwise) —
+    no waiter may hang, the exact contract the PR-5 coordinated abort
+    promises."""
+    inv, fc = _inv(), _fusion()
+    sched = fc.FusionScheduler()
+    entries: list = []
+
+    def producer(i):
+        spec = _sparse_spec(fc)
+        for j in range(2):
+            e = _opaque(fc, f"a{i}.{j}")
+            entries.append(e)
+            sched.enqueue(("sparse", f"k{i}"), spec, e)
+            if j:
+                sched.flush_queue(("sparse", f"k{i}"), "threshold")
+
+    def aborter():
+        sched.abort("chaos: simulated service reset")
+
+    ts = [inv.spawn_thread(producer, name="prod-1", args=(1,)),
+          inv.spawn_thread(producer, name="prod-2", args=(2,)),
+          inv.spawn_thread(aborter, name="aborter")]
+    for t in ts:
+        inv.join_thread(t)
+    sched.flush_all("shutdown")
+    _assert_settled(entries)
+    sched.stop()
+
+
+def quiesce_enqueue_race():
+    """flush_all quiesce racing a live producer: quiesce must return
+    (no self-wait, no lost notify) and everything submitted before the
+    final drain must settle."""
+    inv, fc = _inv(), _fusion()
+    sched = fc.FusionScheduler()
+    entries: list = []
+
+    def producer():
+        spec = _sparse_spec(fc)
+        for j in range(3):
+            e = _opaque(fc, f"q.{j}")
+            entries.append(e)
+            sched.enqueue(("sparse", "kq"), spec, e)
+
+    def drainer():
+        sched.flush_all("barrier")
+
+    ts = [inv.spawn_thread(producer, name="producer"),
+          inv.spawn_thread(drainer, name="drainer"),
+          inv.spawn_thread(drainer, name="drainer-2")]
+    for t in ts:
+        inv.join_thread(t)
+    sched.flush_all("barrier")
+    _assert_settled(entries)
+    sched.stop()
+
+
+class _DictKV:
+    """Non-blocking in-memory KV for watchdog models."""
+
+    def __init__(self):
+        self.d: dict[str, bytes] = {}
+
+    def put(self, key, value):
+        self.d[key] = value
+
+    def get(self, key):
+        return self.d.get(key)
+
+    def keys(self, prefix):
+        return [k for k in sorted(self.d) if k.startswith(prefix)]
+
+
+def watchdog_poison_abort():
+    """Watchdog-poison x executor abort x a blocked waiter: a peer's
+    poison record must convert into on_failure -> scheduler abort, and
+    a thread waiting on a pending entry must unblock with either a
+    result (its flush won) or the abort error — never hang."""
+    import horovod_tpu.health as health
+    inv, fc = _inv(), _fusion()
+    kv = _DictKV()
+    sched = fc.FusionScheduler()
+    spec = _sparse_spec(fc)
+    entry = _opaque(fc, "wd.0")
+    sched.enqueue(("sparse", "kw"), spec, entry)
+    outcomes: list = []
+    decided = inv.make_event("model.watchdog.decided")
+
+    def on_failure(rank, reason):
+        outcomes.append(("failed", rank))
+        sched.abort(f"peer rank {rank} failed: {reason}")
+        decided.set()
+
+    wd = health.HealthWatchdog(kv, 2, 0, "hb", on_failure,
+                               interval_s=0.01, timeout_s=0.05)
+    wd.start()
+
+    def waiter():
+        entry.event.wait(30.0)
+
+    def poisoner():
+        kv.put("hb/poison/1", b"simulated peer error")
+
+    ts = [inv.spawn_thread(waiter, name="waiter"),
+          inv.spawn_thread(poisoner, name="poisoner")]
+    for t in ts:
+        inv.join_thread(t)
+    # virtual-clock wait: the watchdog tick that sees the poison may be
+    # several HVD_HEALTH_INTERVAL periods away
+    if not decided.wait(60.0):
+        raise AssertionError("watchdog never converted the poison record")
+    _assert_settled([entry])
+    wd.stop()
+    sched.flush_all("shutdown")
+    sched.stop()
+    if not outcomes:
+        raise AssertionError("watchdog decision event without an outcome")
+
+
+# -- the PR-3 rendezvous shape (guarded = current code's issue lock) --------
+
+def _rendezvous_model(guarded: bool):
+    """Two threads each launch one multi-device program by appending a
+    per-device participant to every device queue; each device executes
+    its queue in FIFO order and a program only completes when EVERY
+    device has arrived at it (the collective rendezvous). Interleaved
+    launches put the programs in a different order on each device —
+    both devices then wait forever for a participant the other will
+    never run: the exact XLA CPU deadlock PR 3 reproduced. The guarded
+    variant wraps launch in the real ``program_issue.issue_serialized``
+    and must survive exploration."""
+    inv = _inv()
+    from horovod_tpu.ops import program_issue
+    cv = inv.make_condition("model.rendezvous.cv")
+    queues: list[list[str]] = [[] for _ in range(NDEVICES)]
+    arrived: dict[str, int] = {}
+
+    def launch(prog):
+        for d in range(NDEVICES):
+            with cv:
+                queues[d].append(prog)
+                cv.notify_all()
+
+    if guarded:
+        # the current protection, straight from the tree: if someone
+        # removes the program-issue lock, this model deadlocks. The
+        # module-level RLock was created at import time (before
+        # HVD_SCHED_CHECK could take effect for test-scoped runs), so
+        # re-create it through the seam if it is not yet cooperative.
+        from . import primitives
+        if not isinstance(program_issue._ISSUE_LOCK, primitives.RLock):
+            program_issue._ISSUE_LOCK = inv.make_rlock("program_issue.issue")
+        launch = program_issue.issue_serialized(launch)
+
+    def device(d):
+        for _ in range(2):  # two programs total, one participant each
+            with cv:
+                while not queues[d]:
+                    cv.wait()
+                prog = queues[d].pop(0)
+                arrived[prog] = arrived.get(prog, 0) + 1
+                cv.notify_all()
+                while arrived[prog] < NDEVICES:
+                    cv.wait()
+                cv.notify_all()
+
+    ts = [inv.spawn_thread(device, name=f"device-{d}", args=(d,))
+          for d in range(NDEVICES)]
+    ts += [inv.spawn_thread(launch, name="launch-A", args=("progA",)),
+           inv.spawn_thread(launch, name="launch-B", args=("progB",))]
+    for t in ts:
+        inv.join_thread(t)
+
+
+def pr3_issue_lock():
+    _rendezvous_model(guarded=True)
+
+
+def pr3_unguarded():
+    _rendezvous_model(guarded=False)
+
+
+# -- the PR-6 starvation shape (guarded = eager-chain auto-disable) ---------
+
+def _starvation_model(guarded: bool):
+    """A shared 2-slot execution pool (the XLA CPU client's per-device
+    thread pool), an in-flight 2-chunk collective whose chunks each
+    need a pool slot, and two consumer programs that depend on the
+    collective's result. Unguarded consumers grab a slot FIRST and then
+    block on the result — with both slots held by blocked consumers the
+    chunks can never run and the result never materializes (the PR-6
+    eager-chain starvation). The guarded variant materializes the
+    result before consumers claim slots (``HVD_EAGER_CHAIN`` auto-off
+    on CPU) and must survive exploration."""
+    inv = _inv()
+    pool_cv = inv.make_condition("model.pool.cv")
+    free = [2]  # pool slots
+    result = inv.make_event("model.collective.result")
+    chunks_done = [0]
+
+    def take_slot():
+        with pool_cv:
+            while free[0] == 0:
+                pool_cv.wait()
+            free[0] -= 1
+
+    def put_slot():
+        with pool_cv:
+            free[0] += 1
+            pool_cv.notify_all()
+
+    def chunk(_i):
+        take_slot()
+        chunks_done[0] += 1
+        if chunks_done[0] == 2:
+            result.set()
+        put_slot()
+
+    def consumer(_i):
+        if guarded:
+            result.wait()  # materialize before claiming compute
+            take_slot()
+        else:
+            take_slot()
+            result.wait()  # chained on an in-flight collective
+        put_slot()
+
+    ts = [inv.spawn_thread(consumer, name=f"consumer-{i}", args=(i,))
+          for i in range(2)]
+    ts += [inv.spawn_thread(chunk, name=f"chunk-{i}", args=(i,))
+           for i in range(2)]
+    for t in ts:
+        inv.join_thread(t)
+
+
+def pr6_chain_guard():
+    _starvation_model(guarded=True)
+
+
+def pr6_unguarded():
+    _starvation_model(guarded=False)
+
+
+# ---------------------------------------------------------------------------
+# known-bad demos (exploration MUST find these)
+# ---------------------------------------------------------------------------
+
+def deadlock_demo():
+    """Classic two-lock inversion: T1 takes a then b, T2 takes b then
+    a. Some schedules deadlock; the report must name both locks — the
+    same edge the HVD_DEBUG_INVARIANTS lock-order witness records."""
+    inv = _inv()
+    a = inv.make_lock("demo.a")
+    b = inv.make_lock("demo.b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    ts = [inv.spawn_thread(t1, name="t1"), inv.spawn_thread(t2, name="t2")]
+    for t in ts:
+        inv.join_thread(t)
+
+
+def lost_wakeup_demo():
+    """Missed-signal bug: the waiter checks the flag OUTSIDE the lock,
+    so a schedule where the setter fires between the check and the wait
+    leaves the waiter waiting for a notify that already happened. Most
+    schedules pass — only exploration finds the window."""
+    inv = _inv()
+    cv = inv.make_condition("demo.cv")
+    flag: list = []
+
+    def setter():
+        with cv:
+            flag.append(1)
+            cv.notify_all()
+
+    def waiter():
+        if not flag:  # BUG: check/wait are not atomic
+            with cv:
+                cv.wait()
+
+    ts = [inv.spawn_thread(waiter, name="waiter"),
+          inv.spawn_thread(setter, name="setter")]
+    for t in ts:
+        inv.join_thread(t)
+
+
+MATRIX = {
+    "enqueue-flush": enqueue_flush_quiesce,
+    "flush-abort": flush_abort_race,
+    "quiesce-race": quiesce_enqueue_race,
+    "watchdog-abort": watchdog_poison_abort,
+    "pr3-issue-lock": pr3_issue_lock,
+    "pr6-chain-guard": pr6_chain_guard,
+}
+
+DEMOS = {
+    "deadlock-demo": deadlock_demo,
+    "lost-wakeup-demo": lost_wakeup_demo,
+    "pr3-unguarded": pr3_unguarded,
+    "pr6-unguarded": pr6_unguarded,
+}
+
+MODELS = {**MATRIX, **DEMOS}

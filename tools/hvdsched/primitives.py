@@ -1,0 +1,237 @@
+"""Cooperative threading primitives for hvdsched model runs.
+
+These are what ``horovod_tpu/utils/invariants.py`` returns under
+``HVD_SCHED_CHECK=1``: drop-in ``Lock``/``RLock``/``Condition``/``Event``
+duck-types plus ``spawn_thread``/``join_thread``/``sleep``/``monotonic``
+helpers. Every operation checks, **per call**, whether the calling
+thread is a managed task of the active :class:`~.runtime.Runtime`:
+
+* managed -> the operation routes through the runtime (a schedule
+  point; blocking parks the task; timed waits use the virtual clock);
+* unmanaged (no model run active, or a thread outside the run) -> the
+  operation falls through to a real :mod:`threading` primitive, so a
+  ``HVD_SCHED_CHECK=1`` process behaves normally outside model runs
+  (imports, test setup, post-run assertions).
+
+The two modes share observable *state* where it matters for post-run
+assertions (``Event.is_set``, ``Lock.locked``) but not blocking
+semantics: a primitive must not be **contended** across the
+managed/unmanaged boundary during a run. In practice that cannot
+happen — a model run serializes every managed thread and the
+controller never touches model primitives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import runtime as _rt
+
+
+def _managed():
+    return _rt.current()
+
+
+class Lock:
+    """Cooperative mutex. Duck-types ``threading.Lock`` (acquire /
+    release / locked / context manager) and carries ``name`` like the
+    invariants witness's tracked locks."""
+
+    _reentrant = False
+
+    def __init__(self, name: str = "lock"):
+        self.name = name
+        self._real = self._make_real()
+        # cooperative state (touched only while serialized)
+        self._owner = None
+        self._count = 0
+        self._waiters: list = []
+
+    @staticmethod
+    def _make_real():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ctx = _managed()
+        if ctx is None:
+            _rt.check_exit()
+            if timeout is None or timeout < 0:
+                return self._real.acquire(blocking)
+            return self._real.acquire(blocking, timeout)
+        rt, task = ctx
+        return rt.lock_acquire(self, task, blocking, timeout)
+
+    def release(self) -> None:
+        ctx = _managed()
+        if ctx is None:
+            self._real.release()
+            return
+        rt, task = ctx
+        rt.lock_release(self, task)
+
+    def locked(self) -> bool:
+        if self._owner is not None:
+            return True
+        locked = getattr(self._real, "locked", None)
+        return bool(locked()) if locked is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<hvdsched.{type(self).__name__} {self.name!r}>"
+
+
+class RLock(Lock):
+    _reentrant = True
+
+    @staticmethod
+    def _make_real():
+        return threading.RLock()
+
+
+class Condition:
+    """Cooperative condition variable over a cooperative :class:`Lock`.
+    Exposes ``_lock`` (the invariants module's ``holding()`` peeks at
+    it) and the stock wait/notify/notify_all surface."""
+
+    def __init__(self, lock: Lock | None = None, name: str = "cv"):
+        self._coop_lock = lock if lock is not None else Lock(name)
+        self._lock = self._coop_lock
+        self.name = self._coop_lock.name
+        self._waiters: list = []
+        self._real = threading.Condition(self._coop_lock._real)
+
+    def acquire(self, *a, **kw):
+        return self._coop_lock.acquire(*a, **kw)
+
+    def release(self):
+        self._coop_lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        ctx = _managed()
+        if ctx is None:
+            _rt.check_exit()
+            return self._real.wait(timeout)
+        rt, task = ctx
+        return rt.cv_wait(self, task, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        ctx = _managed()
+        if ctx is None:
+            self._real.notify(n)
+            return
+        rt, task = ctx
+        rt.cv_notify(self, task, n)
+
+    def notify_all(self) -> None:
+        ctx = _managed()
+        if ctx is None:
+            self._real.notify_all()
+            return
+        rt, task = ctx
+        rt.cv_notify(self, task, len(self._waiters))
+
+    def __repr__(self):
+        return f"<hvdsched.Condition {self.name!r}>"
+
+
+class Event:
+    """Cooperative event. The flag itself is shared between the
+    managed and unmanaged paths (a post-run assertion on
+    ``entry.event.is_set()`` must see what the model set)."""
+
+    def __init__(self, name: str = "event"):
+        self.name = name
+        self._flag = False
+        self._real = threading.Event()
+        self._waiters: list = []
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        ctx = _managed()
+        self._flag = True
+        self._real.set()
+        if ctx is not None:
+            rt, task = ctx
+            rt.event_set(self, task)
+
+    def clear(self) -> None:
+        ctx = _managed()
+        self._flag = False
+        self._real.clear()
+        if ctx is not None:
+            rt, task = ctx
+            rt.event_clear(self, task)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        ctx = _managed()
+        if ctx is None:
+            _rt.check_exit()
+            return self._real.wait(timeout)
+        rt, task = ctx
+        return rt.event_wait(self, task, timeout)
+
+    def __repr__(self):
+        return f"<hvdsched.Event {self.name!r} set={self._flag}>"
+
+
+def spawn_thread(target, *, name: str, daemon: bool = True,
+                 args=(), kwargs=None) -> threading.Thread:
+    """Create AND start a thread; registers it as a managed task when
+    called from inside a model run, plain daemon thread otherwise."""
+    kwargs = kwargs or {}
+    ctx = _managed()
+    if ctx is not None:
+        rt, _task = ctx
+        return rt.spawn(target, name=name, daemon=daemon,
+                        args=args, kwargs=kwargs)
+    t = threading.Thread(target=target, name=name, daemon=daemon,
+                         args=args, kwargs=kwargs)
+    t.start()
+    return t
+
+
+def join_thread(thread: threading.Thread, timeout=None) -> None:
+    ctx = _managed()
+    if ctx is not None:
+        rt, task = ctx
+        if any(t.thread is thread for t in rt.tasks.values()):
+            rt.join(thread, task, timeout)
+            return
+    _rt.check_exit()
+    thread.join(timeout)
+
+
+def sleep(seconds: float) -> None:
+    ctx = _managed()
+    if ctx is not None:
+        rt, task = ctx
+        rt.sleep(task, seconds)
+        return
+    _rt.check_exit()
+    time.sleep(seconds)
+
+
+def monotonic() -> float:
+    ctx = _managed()
+    if ctx is not None:
+        rt, _task = ctx
+        return rt.clock
+    return time.monotonic()

@@ -1,0 +1,574 @@
+"""hvdsched runtime: the cooperative serializing scheduler.
+
+The model checker's core mechanism (docs/schedule_checker.md): every
+thread participating in a model run is a *managed task* — a real OS
+thread that only executes while the controller has scheduled it. At
+every interleaving point (lock acquire/release, condition wait/notify,
+event wait/set/clear, sleep, thread spawn/join) the running task parks
+on its own semaphore and hands control back to the controller, which
+picks the next runnable task from a **seeded PRNG** (or from a replay
+trace). Exactly one task runs at a time, so a run is fully determined
+by ``(model, seed, trace)`` — any failing schedule replays
+byte-for-byte.
+
+Time is **virtual**: ``sleep`` and timed waits record a wake deadline on
+the virtual clock, and the clock only advances when no task is runnable
+(to the earliest deadline). A model therefore never waits wall-clock
+time, and timer-paced code (the fusion cycle loop, watchdog beats,
+retry backoff) runs deterministically.
+
+Built-in failure detectors, all of which raise :class:`SchedFailure`
+carrying ``(seed, trace)`` and a full report (decision trace + every
+blocked task's stack):
+
+* **deadlock** — every live task is blocked with no virtual-clock
+  deadline and the lock wait graph contains a cycle;
+* **lost-wakeup** — same stuck condition, but the wait graph is acyclic
+  and at least one task waits on a condition/event that no live task
+  can ever signal;
+* **livelock** — the schedule exceeds ``max_steps`` decisions without
+  completing;
+* **replay divergence** — a supplied trace names a task that is not
+  runnable at that step (the model changed under the trace).
+
+The runtime also records the **acquisition-order edge graph** (held
+lock -> acquired lock) that the explorer uses to rank preemption
+points — the dynamic twin of ``utils/invariants.py``'s lock-order
+witness edges.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+import traceback
+
+RUNNABLE = "runnable"
+BLOCKED = "blocked"
+DONE = "done"
+
+_DEFAULT_MAX_STEPS = 20000
+
+
+class SchedError(RuntimeError):
+    """Misuse of the runtime itself (not a model finding)."""
+
+
+class SchedExit(BaseException):
+    """Raised inside managed threads during teardown to unwind them.
+    A ``BaseException`` so ``except Exception`` handlers in the code
+    under test cannot absorb the unwind."""
+
+
+class SchedFailure(AssertionError):
+    """A schedule-level finding (deadlock / lost-wakeup / livelock /
+    replay divergence / model exception). Carries everything needed to
+    replay the exact schedule: ``seed`` and ``trace`` (the decision
+    list), plus a human-readable ``report``."""
+
+    def __init__(self, kind: str, message: str, *, seed: int,
+                 trace: list[int], report: str = ""):
+        self.kind = kind
+        self.seed = seed
+        self.trace = list(trace)
+        self.report = report
+        super().__init__(
+            f"[{kind}] {message}\n"
+            f"replay: seed={seed} trace={self.trace!r}\n{report}")
+
+
+class _Task:
+    __slots__ = ("tid", "name", "thread", "gate", "state", "daemon",
+                 "wait_kind", "wait_resource", "wake_at", "timed_out",
+                 "op", "error", "joiners", "held")
+
+    def __init__(self, tid: int, name: str, daemon: bool):
+        self.tid = tid
+        self.name = name
+        self.thread: threading.Thread | None = None
+        self.gate = threading.Semaphore(0)
+        self.state = RUNNABLE
+        self.daemon = daemon
+        self.wait_kind: str | None = None
+        self.wait_resource = None
+        self.wake_at: float | None = None
+        self.timed_out = False
+        self.op: tuple | None = ("start", self.name if name else "")
+        self.error: BaseException | None = None
+        self.joiners: list["_Task"] = []
+        self.held: list = []  # cooperative locks currently held
+
+    def __repr__(self):
+        return f"<task {self.tid}:{self.name} {self.state}>"
+
+
+class Result:
+    """A completed (clean) run: the decision trace, the per-decision
+    snapshots the explorer branches from, and the acquisition-order
+    edges observed."""
+
+    __slots__ = ("seed", "trace", "points", "edges", "steps", "clock")
+
+    def __init__(self, seed, trace, points, edges, steps, clock):
+        self.seed = seed
+        self.trace = trace
+        self.points = points
+        self.edges = edges
+        self.steps = steps
+        self.clock = clock
+
+
+_active: "Runtime | None" = None
+
+
+def active() -> "Runtime | None":
+    return _active
+
+
+def current():
+    """``(runtime, task)`` when the calling thread is a managed task of
+    the active runtime (and the run is not tearing down), else None."""
+    rt = _active
+    if rt is None or rt._finishing:
+        return None
+    task = rt._by_ident.get(threading.get_ident())
+    if task is None:
+        return None
+    return rt, task
+
+
+def check_exit() -> None:
+    """Unwind managed threads during teardown: any blocking primitive
+    entered by a managed thread of a finishing runtime raises
+    :class:`SchedExit` instead of really blocking."""
+    rt = _active
+    if (rt is not None and rt._finishing
+            and threading.get_ident() in rt._by_ident):
+        raise SchedExit
+
+
+class Runtime:
+    """One controlled model run. Use :meth:`run`; the calling thread
+    becomes the controller, ``fn`` runs as the non-daemon ``main``
+    task."""
+
+    def __init__(self, seed: int = 0, trace=None,
+                 max_steps: int = _DEFAULT_MAX_STEPS, name: str = "model"):
+        self.seed = int(seed)
+        self.name = name
+        self.rng = random.Random(self.seed)
+        self.replay = list(trace) if trace else None
+        self.max_steps = int(max_steps)
+        self.clock = 0.0
+        self.decisions: list[int] = []
+        self.points: list[dict] = []
+        self.edges: set[tuple[str, str]] = set()
+        self.failure: SchedFailure | None = None
+        self.tasks: dict[int, _Task] = {}
+        self._by_ident: dict[int, _Task] = {}
+        self._ctrl = threading.Semaphore(0)
+        self._next_tid = 0
+        self._finishing = False
+        self._error: BaseException | None = None
+
+    # -- task lifecycle ----------------------------------------------------
+
+    def spawn(self, target, *, name: str, daemon: bool = True,
+              args=(), kwargs=None) -> threading.Thread:
+        kwargs = kwargs or {}
+        tid = self._next_tid
+        self._next_tid += 1
+        task = _Task(tid, name or f"task-{tid}", daemon)
+        th = threading.Thread(
+            target=self._wrapper, args=(task, target, args, kwargs),
+            name=task.name, daemon=True)
+        task.thread = th
+        self.tasks[tid] = task
+        th.start()
+        spawner = self._by_ident.get(threading.get_ident())
+        if spawner is not None and not self._finishing:
+            self._yield(spawner, ("spawn", task.name))
+        return th
+
+    def _wrapper(self, task: _Task, target, args, kwargs) -> None:
+        self._by_ident[threading.get_ident()] = task
+        task.gate.acquire()  # wait to be scheduled the first time
+        if not self._finishing:
+            try:
+                target(*args, **kwargs)
+            except SchedExit:
+                pass
+            except BaseException as e:  # surfaced by the controller
+                task.error = e
+        self._finish_task(task)
+
+    def _finish_task(self, task: _Task) -> None:
+        task.state = DONE
+        if (task.held and not self._finishing and task.error is None):
+            # a thread exiting while holding a lock is a permanent
+            # deadlock in real threading (locks are never auto-released
+            # by a dying owner) — report it rather than mask it with
+            # the unwind-path force-release below
+            task.error = SchedFailure(
+                "lock-leak",
+                f"task {task.name!r} exited holding "
+                f"{[l.name for l in task.held]!r}: a real thread's exit "
+                "never releases its locks, so any waiter blocks forever",
+                seed=self.seed, trace=self.decisions,
+                report=self._describe(
+                    [t for t in self._ordered() if t.state != DONE]))
+        for lock in list(task.held):  # a dying task must not wedge others
+            try:
+                lock._owner = None
+                lock._count = 0
+                for w in lock._waiters:
+                    w.state = RUNNABLE
+                lock._waiters.clear()
+            except Exception:
+                pass
+        task.held.clear()
+        for j in task.joiners:
+            if j.state == BLOCKED and j.wait_kind == "join":
+                j.state = RUNNABLE
+        task.joiners.clear()
+        self._ctrl.release()
+
+    # -- park / yield / block ----------------------------------------------
+
+    def _park(self, task: _Task) -> None:
+        self._ctrl.release()
+        task.gate.acquire()
+        if self._finishing:
+            raise SchedExit
+
+    def _yield(self, task: _Task, op: tuple) -> None:
+        """A schedule point: the task stays runnable but hands control
+        back so the scheduler may run someone else first."""
+        task.op = op
+        self._park(task)
+
+    def _block(self, task: _Task, kind: str, resource,
+               wake_at: float | None, op: tuple | None = None) -> bool:
+        """Park blocked on ``resource``; returns False when woken by the
+        virtual-clock deadline instead of a signal."""
+        task.state = BLOCKED
+        task.wait_kind = kind
+        task.wait_resource = resource
+        task.wake_at = wake_at
+        task.op = op or (kind, _rname(resource))
+        self._park(task)
+        task.wait_kind = None
+        task.wait_resource = None
+        task.wake_at = None
+        timed_out, task.timed_out = task.timed_out, False
+        return not timed_out
+
+    # -- cooperative primitive operations ----------------------------------
+
+    def lock_acquire(self, lock, task: _Task, blocking: bool = True,
+                     timeout: float = -1) -> bool:
+        self._yield(task, ("acquire", lock.name))
+        deadline = None
+        if blocking and timeout is not None and timeout >= 0:
+            deadline = self.clock + timeout
+        while True:
+            if lock._owner is None:
+                lock._owner = task
+                lock._count = 1
+                for h in task.held:
+                    if h is not lock:
+                        self.edges.add((h.name, lock.name))
+                task.held.append(lock)
+                return True
+            if lock._owner is task and lock._reentrant:
+                lock._count += 1
+                return True
+            if not blocking:
+                return False
+            lock._waiters.append(task)
+            if not self._block(task, "lock", lock, deadline):
+                return False  # virtual-clock timeout
+            # woken by a release (or the owner dying): re-contend
+
+    def lock_release(self, lock, task: _Task) -> None:
+        if lock._owner is not task:
+            raise SchedError(
+                f"release of {lock.name!r} by {task.name!r}, owned by "
+                f"{getattr(lock._owner, 'name', None)!r}")
+        lock._count -= 1
+        if lock._count == 0:
+            lock._owner = None
+            task.held.remove(lock)
+            for w in lock._waiters:
+                w.state = RUNNABLE
+            lock._waiters.clear()
+        self._yield(task, ("release", lock.name))
+
+    def cv_wait(self, cv, task: _Task, timeout: float | None = None) -> bool:
+        lock = cv._coop_lock
+        if lock._owner is not task:
+            raise SchedError(f"cv {cv.name!r}: wait() without the lock")
+        saved = lock._count
+        lock._count = 0
+        lock._owner = None
+        task.held.remove(lock)
+        for w in lock._waiters:
+            w.state = RUNNABLE
+        lock._waiters.clear()
+        cv._waiters.append(task)
+        deadline = None if timeout is None else self.clock + timeout
+        signaled = self._block(task, "cv", cv, deadline,
+                               op=("cv-wait", cv.name))
+        self.lock_acquire(lock, task)
+        lock._count = saved
+        return signaled
+
+    def cv_notify(self, cv, task: _Task, n: int) -> None:
+        if cv._coop_lock._owner is not task:
+            raise SchedError(f"cv {cv.name!r}: notify() without the lock")
+        woken, cv._waiters[:n] = cv._waiters[:n], []
+        for w in woken:
+            w.state = RUNNABLE  # each re-acquires the lock when scheduled
+        self._yield(task, ("notify", cv.name))
+
+    def event_wait(self, ev, task: _Task,
+                   timeout: float | None = None) -> bool:
+        self._yield(task, ("event-wait", ev.name))
+        if ev._flag:
+            return True
+        deadline = None if timeout is None else self.clock + timeout
+        ev._waiters.append(task)
+        self._block(task, "event", ev, deadline)
+        return ev._flag
+
+    def event_set(self, ev, task: _Task) -> None:
+        ev._flag = True
+        for w in ev._waiters:
+            w.state = RUNNABLE
+        ev._waiters.clear()
+        self._yield(task, ("set", ev.name))
+
+    def event_clear(self, ev, task: _Task) -> None:
+        ev._flag = False
+        self._yield(task, ("clear", ev.name))
+
+    def sleep(self, task: _Task, seconds: float) -> None:
+        self._block(task, "sleep", None, self.clock + max(seconds, 0.0),
+                    op=("sleep", f"{seconds:g}"))
+
+    def join(self, thread: threading.Thread, task: _Task,
+             timeout: float | None = None) -> None:
+        target = next((t for t in self.tasks.values()
+                       if t.thread is thread), None)
+        if target is None:
+            raise SchedError("join of a thread the runtime never spawned")
+        if target.state == DONE:
+            self._yield(task, ("join", target.name))
+            return
+        target.joiners.append(task)
+        deadline = None if timeout is None else self.clock + timeout
+        if not self._block(task, "join", target, deadline,
+                           op=("join", target.name)):
+            if task in target.joiners:
+                target.joiners.remove(task)
+
+    # -- the controller ----------------------------------------------------
+
+    def run(self, fn) -> Result:
+        """Run ``fn`` as the model's main task under this runtime's
+        schedule. Raises the model's own exception, or
+        :class:`SchedFailure` on a detector hit; returns a
+        :class:`Result` on a clean run."""
+        global _active
+        if _active is not None:
+            raise SchedError("an hvdsched runtime is already active "
+                             "(model runs cannot nest)")
+        _active = self
+        try:
+            self.spawn(fn, name="main", daemon=False)
+            self._controller_loop()
+        finally:
+            self._teardown()
+            _active = None
+        if self._error is not None:
+            if (isinstance(self._error, AssertionError)
+                    and not isinstance(self._error, SchedFailure)):
+                # a model CONTRACT assertion (entry never settled, a
+                # waiter hung) is a schedule finding: it must carry the
+                # (seed, trace) replay data like every other detector,
+                # not escape as a bare AssertionError the explorer and
+                # the CI gate cannot reproduce
+                raise SchedFailure(
+                    "model-assertion", str(self._error),
+                    seed=self.seed, trace=self.decisions) from self._error
+            raise self._error
+        if self.failure is not None:
+            raise self.failure
+        return Result(self.seed, list(self.decisions), self.points,
+                      set(self.edges), len(self.decisions), self.clock)
+
+    def _ordered(self) -> list[_Task]:
+        return [self.tasks[k] for k in sorted(self.tasks)]
+
+    def _controller_loop(self) -> None:
+        while True:
+            tasks = self._ordered()
+            errored = next((t for t in tasks if t.error is not None), None)
+            if errored is not None:
+                self._error = errored.error
+                return
+            live = [t for t in tasks if t.state != DONE]
+            if not any(not t.daemon for t in live):
+                return  # model complete; leftover daemons torn down
+            runnable = [t for t in live if t.state == RUNNABLE]
+            if not runnable:
+                timed = [t for t in live if t.wake_at is not None]
+                if timed:
+                    self.clock = max(self.clock,
+                                     min(t.wake_at for t in timed))
+                    for t in timed:
+                        if t.wake_at is not None and t.wake_at <= self.clock:
+                            self._wake_timeout(t)
+                    continue
+                self._fail_stuck(live)
+                return
+            if len(self.decisions) >= self.max_steps:
+                self.failure = SchedFailure(
+                    "livelock",
+                    f"schedule exceeded {self.max_steps} decisions "
+                    "without completing",
+                    seed=self.seed, trace=self.decisions,
+                    report=self._describe(live))
+                return
+            chosen = self._choose(runnable)
+            if chosen is None:
+                return  # replay divergence recorded
+            chosen.gate.release()
+            self._ctrl.acquire()
+
+    def _wake_timeout(self, task: _Task) -> None:
+        res = task.wait_resource
+        waiters = getattr(res, "_waiters", None)
+        if waiters is not None and task in waiters:
+            waiters.remove(task)
+        if task.wait_kind == "join" and res is not None:
+            if task in res.joiners:
+                res.joiners.remove(task)
+        task.timed_out = task.wait_kind != "sleep"
+        task.state = RUNNABLE
+
+    def _choose(self, runnable: list[_Task]) -> _Task | None:
+        runnable = sorted(runnable, key=lambda t: t.tid)
+        k = len(self.decisions)
+        if self.replay is not None and k < len(self.replay):
+            want = self.replay[k]
+            chosen = next((t for t in runnable if t.tid == want), None)
+            if chosen is None:
+                self.failure = SchedFailure(
+                    "replay-divergence",
+                    f"trace step {k} schedules task {want}, but runnable "
+                    f"tasks are {[t.tid for t in runnable]} — the model "
+                    "diverged from the recorded run",
+                    seed=self.seed, trace=self.decisions)
+                return None
+        else:
+            chosen = runnable[self.rng.randrange(len(runnable))]
+        self.points.append({
+            "step": k,
+            "runnable": [t.tid for t in runnable],
+            "ops": {t.tid: t.op for t in runnable},
+            "chosen": chosen.tid,
+        })
+        self.decisions.append(chosen.tid)
+        return chosen
+
+    # -- stuck detection ---------------------------------------------------
+
+    def _fail_stuck(self, live: list[_Task]) -> None:
+        cycle = self._lock_cycle(live)
+        if cycle:
+            kind = "deadlock"
+            message = ("all threads blocked; lock wait cycle: "
+                       + " -> ".join(cycle))
+        elif any(t.wait_kind in ("cv", "event") for t in live):
+            kind = "lost-wakeup"
+            waiters = [t for t in live if t.wait_kind in ("cv", "event")]
+            message = ("all threads blocked; "
+                       + ", ".join(f"{t.name} waits on "
+                                   f"{t.wait_kind} {_rname(t.wait_resource)!r}"
+                                   for t in waiters)
+                       + " with no live thread able to signal")
+        else:
+            kind = "deadlock"
+            message = "all threads blocked with a non-empty wait graph"
+        self.failure = SchedFailure(kind, message, seed=self.seed,
+                                    trace=self.decisions,
+                                    report=self._describe(live))
+
+    def _lock_cycle(self, live: list[_Task]) -> list[str] | None:
+        """A cycle in task -> lock-owner edges, as lock names."""
+        waits = {}
+        for t in live:
+            if t.wait_kind == "lock" and t.wait_resource is not None:
+                owner = t.wait_resource._owner
+                if owner is not None:
+                    waits[t.tid] = (owner.tid, t.wait_resource.name)
+        for start in waits:
+            seen, path = {start}, []
+            cur = start
+            while cur in waits:
+                nxt, lname = waits[cur]
+                path.append(lname)
+                if nxt == start:
+                    return path
+                if nxt in seen:
+                    break
+                seen.add(nxt)
+                cur = nxt
+        return None
+
+    def _describe(self, live: list[_Task]) -> str:
+        frames = sys._current_frames()
+        lines = [f"decision trace ({len(self.decisions)} steps): "
+                 f"{self.decisions!r}",
+                 "tasks:"]
+        for t in self._ordered():
+            held = ",".join(l.name for l in t.held) or "-"
+            what = (f"{t.wait_kind} on {_rname(t.wait_resource)!r}"
+                    if t.state == BLOCKED else t.state)
+            lines.append(f"  [{t.tid}] {t.name}: {what} "
+                         f"(held: {held}, daemon: {t.daemon})")
+            if t.state == BLOCKED and t.thread is not None:
+                frame = frames.get(t.thread.ident)
+                if frame is not None:
+                    stack = traceback.format_stack(frame)
+                    # drop the runtime's own park frames from the tail
+                    stack = [s for s in stack
+                             if "/hvdsched/runtime.py" not in s]
+                    lines.append("".join(stack[-8:]).rstrip())
+        return "\n".join(lines)
+
+    # -- teardown ----------------------------------------------------------
+
+    def _teardown(self) -> None:
+        self._finishing = True
+        for _ in range(500):
+            alive = [t for t in self.tasks.values()
+                     if t.state != DONE and t.thread is not None
+                     and t.thread.is_alive()]
+            if not alive:
+                break
+            for t in alive:
+                t.gate.release()
+            time.sleep(0.001)
+        for t in self.tasks.values():
+            if t.thread is not None and t.thread.is_alive():
+                t.thread.join(timeout=1.0)
+
+
+def _rname(res) -> str:
+    if res is None:
+        return "-"
+    return getattr(res, "name", None) or str(res)
