@@ -835,6 +835,235 @@ def run_step_bench(args) -> None:
     }))
 
 
+def _capture_bench_case(hvd, n, args):
+    """Dispatch-bound eager DP transformer step for --capture-bench: a
+    deep-but-narrow TransformerLM whose gradient tree has MANY small
+    leaves (the per-parameter regime MULTICHIP_r05 showed drowning in
+    eager dispatch), local backward jitted with no collectives inside —
+    gradient sync through DistributedOptimizer's bucketed stream is the
+    path capture records and replays."""
+    import jax.numpy as jnp  # noqa: F811 - local for clarity
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    mesh = hvd.mesh()
+    axis = hvd.axis_name()
+    batch = args.capture_batch
+    seq = args.capture_seq_len
+    cfg = TransformerConfig(vocab_size=args.capture_vocab,
+                            num_layers=args.capture_layers,
+                            num_heads=4, d_model=args.capture_dmodel,
+                            d_ff=4 * args.capture_dmodel,
+                            max_seq_len=seq, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    x_host = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(n * batch, seq))
+    params0 = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, seq), jnp.int32))["params"]
+
+    def local(p, x_i):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x_i)
+            tgt = jax.nn.one_hot(x_i[:, 1:], cfg.vocab_size)
+            return -jnp.mean(jnp.sum(
+                tgt * jax.nn.log_softmax(logits[:, :-1]), -1))
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        return g, loss
+
+    def shard_fn(p, x_i):
+        g, loss = local(p, x_i)
+        return jax.tree.map(lambda a: a[None], g), loss[None]
+
+    local_fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(), P(axis)),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+    x = jax.device_put(x_host, NamedSharding(mesh, P(axis)))
+    grad_bytes = sum(int(np.prod(l.shape)) * 4
+                     for l in jax.tree.leaves(params0))
+    n_leaves = len(jax.tree.leaves(params0))
+    return local_fn, params0, x, grad_bytes, n_leaves
+
+
+def _run_capture_mode(hvd, local_fn, params0, x, capture_on, iters,
+                      bucket_a, bucket_b):
+    """One pass of the eager DP step with HVD_STEP_CAPTURE pinned:
+    3 warmup steps (with capture on: record @1, compile the whole-step
+    program + first replay @2), ``iters`` timed steps, then a FORCED
+    DIVERGENCE phase — the bucket layout flips mid-run, so the replay
+    must fall back to eager with correct results. The step is jitted
+    backward → EAGER bucketed gradient sync (the
+    ``allreduce_gradients_transform`` stage under test — the one part of
+    an eager-DP step that cannot compile into the user's jit) → jitted
+    optimizer update, so the measured delta is the dispatch machinery
+    capture removes, not eager arithmetic around it. Returns (per-step
+    times, final param leaves, capture stats)."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.ops import dispatch_cache, fusion_cycle
+
+    os.environ["HVD_STEP_CAPTURE"] = "1" if capture_on else "0"
+    os.environ["HVD_BUCKET_BYTES"] = str(bucket_a)
+    dispatch_cache.reset()
+    fusion_cycle.reset()
+    mesh = hvd.mesh()
+
+    params = jax.device_put(params0, NamedSharding(mesh, P()))
+    sync_tx = hvd.allreduce_gradients_transform()
+    sync_state = sync_tx.init(params0)
+    inner = optax.sgd(0.01, momentum=0.9)
+    opt = jax.device_put(inner.init(params0), NamedSharding(mesh, P()))
+    state = {"params": params, "opt": opt}
+
+    @jax.jit
+    def apply_update(p, synced, o):
+        updates, o = inner.update(synced, o, p)
+        return optax.apply_updates(p, updates), o
+
+    def one_step():
+        g, loss = local_fn(state["params"], x)
+        gt = jax.tree.map(lambda a: hvd.PerRank(a), g)
+        synced, _ = sync_tx.update(gt, sync_state)
+        state["params"], state["opt"] = apply_update(
+            state["params"], synced, state["opt"])
+        return loss
+
+    for _ in range(3):
+        one_step()
+    jax.block_until_ready(jax.tree.leaves(state["params"]))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        one_step()
+        jax.block_until_ready(jax.tree.leaves(state["params"]))
+        times.append((time.perf_counter() - t0) * 1e3)
+    # forced divergence: a different bucket layout changes the stream —
+    # the captured plan must invalidate and the steps stay correct
+    os.environ["HVD_BUCKET_BYTES"] = str(bucket_b)
+    for _ in range(2):
+        one_step()
+    jax.block_until_ready(jax.tree.leaves(state["params"]))
+    stats = hvd.fusion_stats()["capture"]
+    leaves = [np.asarray(l) for l in jax.tree.leaves(state["params"])]
+    return times, leaves, stats
+
+
+def run_capture_bench(args) -> None:
+    """Step capture-and-replay benchmark (CPU backend, virtual 8-chip
+    mesh; ISSUE 8 tentpole): end-to-end eager DP transformer step —
+    jitted local backward, bucketed DistributedOptimizer gradient sync —
+    with ``HVD_STEP_CAPTURE`` off (the eager per-flush path: every
+    bucket pays enqueue/flush/fuse/wire/split dispatch) vs on (step 1
+    records the flush stream, later steps replay the whole step's
+    collective work as ONE cached jitted program). Both modes end with a
+    forced-divergence phase (bucket layout flips mid-run) proving the
+    replay falls back to eager with correct results — the final params
+    must match across modes INCLUDING the fallback steps. Prints ONE
+    JSON line; ``value`` is the percent step-time reduction."""
+    hvd, n = _microbench_mesh()
+    knobs = ("HVD_STEP_CAPTURE", "HVD_BUCKET_BYTES", "HVD_CYCLE_TIME",
+             "HVD_PENDING_CYCLE_TIME", "HVD_PIPELINE_THRESHOLD")
+    prev = {k: os.environ.get(k) for k in knobs}
+    try:
+        # timer quiet: every flush comes from the deterministic "bucket"
+        # trigger, so the recorded stream is stable run-to-run
+        os.environ["HVD_CYCLE_TIME"] = "500"
+        os.environ["HVD_PENDING_CYCLE_TIME"] = "500"
+        # 1 MiB chunk threshold in BOTH modes: the eager flushes sit far
+        # below it either way, but the captured program's step-fused
+        # wire buffer crosses it — the multi-MiB monolithic reduction is
+        # measurably slower than its chunked pieces on the CPU mesh
+        # (the PR-3 finding, which step fusion would otherwise re-create)
+        os.environ["HVD_PIPELINE_THRESHOLD"] = str(1 << 20)
+        local_fn, params0, x, grad_bytes, n_leaves = _capture_bench_case(
+            hvd, n, args)
+        bucket_a = args.capture_bucket_bytes
+        # 4x, not 2x: the deep-narrow default tree is dominated by
+        # leaves that sit alone in their bucket at 2x too, which would
+        # leave the layout (and so the stream) unchanged — no divergence
+        bucket_b = 4 * bucket_a
+        # interleaved A/B/A/B passes (same rationale as --step-bench:
+        # both modes see the same CI load drift)
+        eager_t1, eager_params, _ = _run_capture_mode(
+            hvd, local_fn, params0, x, False, args.capture_iters,
+            bucket_a, bucket_b)
+        cap_t1, cap_params, cap_stats = _run_capture_mode(
+            hvd, local_fn, params0, x, True, args.capture_iters,
+            bucket_a, bucket_b)
+        eager_t2, _, _ = _run_capture_mode(
+            hvd, local_fn, params0, x, False, args.capture_iters,
+            bucket_a, bucket_b)
+        cap_t2, _, cap_stats2 = _run_capture_mode(
+            hvd, local_fn, params0, x, True, args.capture_iters,
+            bucket_a, bucket_b)
+        eager_ms = float(np.median(eager_t1 + eager_t2))
+        cap_ms = float(np.median(cap_t1 + cap_t2))
+        match = all(np.allclose(a, b, atol=1e-5)
+                    for a, b in zip(eager_params, cap_params))
+        from horovod_tpu.ops import dispatch_cache
+        cache_stats = dispatch_cache.stats()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    reduction = (eager_ms - cap_ms) / eager_ms * 100.0 if eager_ms else 0.0
+    # BOTH capture passes' lifecycle counters, summed AND per-pass — a
+    # single pass's numbers would let the other pass regress silently
+    replayed_by_pass = [int(cap_stats["replayed_steps"]),
+                        int(cap_stats2["replayed_steps"])]
+    fallbacks_by_pass = [int(cap_stats["fallbacks"]),
+                         int(cap_stats2["fallbacks"])]
+    print(json.dumps({
+        "metric": "step_capture_replay_step_time_reduction",
+        "value": round(reduction, 1),
+        "unit": "% reduction in end-to-end eager DP step time, "
+                "TransformerLM (captured whole-step replay vs the eager "
+                "per-flush path)",
+        "eager": {"ms_per_step": round(eager_ms, 3)},
+        "captured": {"ms_per_step": round(cap_ms, 3),
+                     "capture_pass1": cap_stats,
+                     "capture": cap_stats2,
+                     # each pass resets the dispatch cache, so these
+                     # cover the FINAL capture pass only (cross-check
+                     # them against capture/cap_stats2, not the sums)
+                     "final_pass_hits_by_source":
+                         cache_stats["hits_by_source"],
+                     "final_pass_step_plan_builds":
+                         cache_stats["step_builds"]},
+        "numerics_match": bool(match),
+        # the forced mid-run bucket-layout flip: the replay must have
+        # fallen back (counted, in EVERY capture pass) and the final
+        # params still matched
+        "divergence": {"fallbacks": sum(fallbacks_by_pass),
+                       "fallbacks_by_pass": fallbacks_by_pass,
+                       "invalidations": int(cap_stats["invalidations"])
+                       + int(cap_stats2["invalidations"]),
+                       "numerics_match": bool(match)},
+        "replayed_steps": sum(replayed_by_pass),
+        "replayed_steps_by_pass": replayed_by_pass,
+        "pipeline_overlap": _pipeline_summary(),
+        "baseline": "identical eager DP step with HVD_STEP_CAPTURE=0 "
+                    "(bucketed per-flush dispatch through the fusion "
+                    "cycle + pipelined executor — the pre-capture "
+                    "behavior)",
+        "config": {"model": "TransformerLM",
+                   "vocab": args.capture_vocab,
+                   "layers": args.capture_layers,
+                   "d_model": args.capture_dmodel,
+                   "seq_len": args.capture_seq_len,
+                   "batch_per_chip": args.capture_batch,
+                   "bucket_bytes": bucket_a,
+                   "divergence_bucket_bytes": bucket_b,
+                   "grad_bytes": grad_bytes, "grad_leaves": n_leaves,
+                   "iters": args.capture_iters, "n_chips": n,
+                   "backend": jax.devices()[0].platform},
+    }))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=256,
@@ -948,6 +1177,34 @@ def main():
                              "--step-bench (default 4 MiB so the small "
                              "bench models split into several buckets; "
                              "production default is 64 MiB)")
+    parser.add_argument("--capture-bench", action="store_true",
+                        help="run the step capture-and-replay benchmark "
+                             "(CPU backend, no accelerator probe): eager "
+                             "DP TransformerLM step, HVD_STEP_CAPTURE on "
+                             "(whole-step replay program) vs off (per-"
+                             "flush dispatch), plus a forced-divergence "
+                             "fallback check")
+    parser.add_argument("--capture-iters", type=int, default=8,
+                        help="timed steps per mode pass in --capture-bench")
+    parser.add_argument("--capture-batch", type=int, default=1,
+                        help="per-chip batch size in --capture-bench")
+    parser.add_argument("--capture-seq-len", type=int, default=8,
+                        help="sequence length in --capture-bench")
+    parser.add_argument("--capture-vocab", type=int, default=1024,
+                        help="vocab size in --capture-bench (small: the "
+                             "bench isolates dispatch overhead, not "
+                             "collective bandwidth)")
+    parser.add_argument("--capture-layers", type=int, default=8,
+                        help="transformer layers in --capture-bench "
+                             "(deep-narrow: many small gradient leaves, "
+                             "the per-parameter dispatch regime)")
+    parser.add_argument("--capture-dmodel", type=int, default=64,
+                        help="model width in --capture-bench")
+    parser.add_argument("--capture-bucket-bytes", type=int, default=8192,
+                        help="HVD_BUCKET_BYTES in --capture-bench (tiny: "
+                             "~per-parameter dispatch, the reference's "
+                             "per-layer hook stream; the divergence "
+                             "phase quadruples it)")
     parser.add_argument("--max-wait", type=float, default=600.0,
                         help="max seconds to wait for the accelerator "
                              "backend to answer a clean-exit probe before "
@@ -967,6 +1224,8 @@ def main():
         return run_overlap_bench(args)
     if args.step_bench:
         return run_step_bench(args)
+    if args.capture_bench:
+        return run_capture_bench(args)
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
         # Claiming the backend ourselves now would either fail identically

@@ -96,6 +96,7 @@ from .ops import (
     sparse_allreduce,
     sparse_allreduce_async,
     sparse_allreduce_to_dense,
+    step_marker,
     synchronize,
 )
 from .process_sets import (
@@ -158,7 +159,7 @@ __all__ = [
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
     "broadcast_", "broadcast_async", "broadcast_object",
-    "dispatch_cache_stats", "fusion_flush", "fusion_stats",
+    "dispatch_cache_stats", "fusion_flush", "fusion_stats", "step_marker",
     "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
     "grouped_broadcast_async",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",

@@ -141,6 +141,13 @@ def _default_tunables() -> list[Tunable]:
         # so multi-process composition stays rank-deterministic.
         Tunable(envs.BUCKET_BYTES, [envs.DEFAULT_BUCKET_BYTES,
                                     8 * MB, 16 * MB, 32 * MB, 128 * MB]),
+        # Step capture-and-replay (ops/step_capture.py). Default-off
+        # first so enabling autotune changes nothing at sample 0; when
+        # the tuner flips it on, marked steps record once and replay as
+        # one cached program. Flipping the override bumps the envs
+        # epoch, which drops cached step plans — a stale capture can
+        # never survive a knob change.
+        Tunable(envs.STEP_CAPTURE, [0, 1]),
         Tunable(envs.HIERARCHICAL_ALLREDUCE, [0, 1]),
         # Dispatch-plan/response cache on/off, the reference's cache_enabled
         # tunable (parameter_manager.cc CacheEnabledParameter). Default-on

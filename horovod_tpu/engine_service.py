@@ -151,6 +151,10 @@ class DynamicService:
         self._shutdown = threading.Event()
         self._tick = threading.Event()  # fresh work: skip the cycle sleep
         self._exchange_timeout = envs.get_float(envs.ELASTIC_TIMEOUT, 600.0)
+        # whole-step batched negotiation rounds served for replayed
+        # captured steps (ops/step_capture.py) — one KV cycle covering
+        # every flush of the step
+        self.step_negotiations = 0
         self._last_stall_check = time.monotonic()
         # Health watchdog over the same KV channel the transport uses:
         # liveness beats + poison records turn a dead peer into a
@@ -219,6 +223,19 @@ class DynamicService:
         all requests land in one cycle, so the wait is one round trip."""
         return self.negotiate_many_wait(self.negotiate_many_submit(requests),
                                         timeout=timeout)
+
+    def negotiate_step(self, requests: list[dict],
+                       timeout: float | None = None) -> list[Response]:
+        """Batched negotiation for a replayed captured step
+        (``ops/step_capture.py``): every flush of the step's recorded
+        stream lands in ONE ``negotiate_many`` round — one KV cycle for
+        the whole step instead of one per flush. The round is submitted
+        at the stream-completion point, which is a rank-deterministic
+        program point (the same submission completes the stream on every
+        process running the same program), so the cross-process program
+        issue order is preserved exactly like any user-thread trigger."""
+        self.step_negotiations += 1
+        return self.negotiate_many(requests, timeout=timeout)
 
     def negotiate_many_submit(self, requests: list[dict]) -> NegotiationTicket:
         """First half of :meth:`negotiate_many`: register and enqueue the

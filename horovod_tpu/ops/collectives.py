@@ -909,6 +909,50 @@ def _grouped_donate_mask(metas, alias_risk) -> tuple:
         for (dt, bidxs, _shapes, srcs) in metas)
 
 
+def _sig_donate_mask(metas, sigs, bundled: bool) -> tuple:
+    """Donate mask from plan signatures — THE alias-risk rule in one
+    place, shared by the per-flush plan builders and the step-capture
+    whole-step programs (drift here would re-introduce the donation
+    aliasing bug on exactly one of the two paths)."""
+    if bundled:
+        return _grouped_donate_mask(
+            metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
+    return _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
+
+
+def _fuse_closure(metas, n: int, bundled: bool):
+    """Shared fuse body (list of canonicalized inputs -> per-dtype wire
+    buffers), traced inside the per-flush plan programs AND the
+    step-capture whole-step programs — one definition, so wire
+    packaging can never drift between the two paths."""
+    if bundled:
+        def fuse(arrs):
+            return [jnp.concatenate([arrs[i].astype(dt).reshape(n, -1)
+                                     for i in bidxs], axis=1)
+                    for (dt, bidxs, _s, _src) in metas]
+    else:
+        def fuse(arrs):
+            return [jnp.concatenate([arrs[i].astype(dt).reshape(-1)
+                                     for i in bidxs])
+                    if len(bidxs) > 1
+                    else arrs[bidxs[0]].astype(dt).reshape(-1)
+                    for (dt, bidxs, _s, _src) in metas]
+    return fuse
+
+
+def _canon_closure(shapes, n: int, bundled: bool):
+    """Shared input canonicalizer (user tensors -> fuse-program inputs):
+    PerRank bundles pass through / raw arrays expand under the bundle
+    strategy; everything to jnp arrays under the replicated strategy."""
+    if bundled:
+        def canon(ts):
+            return [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
+    else:
+        def canon(ts):
+            return [jnp.asarray(t) for t in ts]
+    return canon
+
+
 def _build_allreduce_plan(sig, pset: ProcessSet, axis, op: ReduceOp,
                           pre_f: float, post_f: float, name: str | None):
     _check_bundle_axis(sig, pset)
@@ -965,26 +1009,18 @@ def _plan_fused_programs(metas, smap, n: int, count: int, bundled: bool,
     dispatcher-owned memory (``donate`` additionally excludes buffers a
     backend's input-output forwarding could alias to a user array:
     identity-reshape single-tensor buckets)."""
-    if bundled:
-        def fuse(*bundles):
-            return tuple(jnp.concatenate([bundles[i].astype(dt)
-                                          .reshape(n, -1)
-                                          for i in bidxs], axis=1)
-                         for (dt, bidxs, _s, _src) in metas)
+    body = _fuse_closure(metas, n, bundled)
 
+    def fuse(*arrs):
+        return tuple(body(list(arrs)))
+
+    if bundled:
         def wire(*fused):
             outs = smap(*fused)
             if row0:
                 outs = [o[0] for o in outs]
             return tuple(_split_fused(list(outs), metas, count))
     else:
-        def fuse(*arrs):
-            return tuple(jnp.concatenate([arrs[i].astype(dt).reshape(-1)
-                                          for i in bidxs])
-                         if len(bidxs) > 1
-                         else arrs[bidxs[0]].astype(dt).reshape(-1)
-                         for (dt, bidxs, _s, _src) in metas)
-
         def wire(*fused):
             return tuple(_split_fused(list(smap(*fused)), metas, count))
     fuse_fn = _issue_serialized(jax.jit(fuse))
@@ -1217,11 +1253,7 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
         return _dispatch.DispatchPlan(name or "grouped_allreduce",
                                       "GROUPED_ALLREDUCE", nbytes,
                                       negotiate, execute)
-    if bundled:
-        donate = _grouped_donate_mask(
-            metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
-    else:
-        donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
+    donate = _sig_donate_mask(metas, sigs, bundled)
     layout = None if hier else _chunk_layout(metas)
     if layout is not None:
         # Chunk pipeline: fuse emits per-chunk wire buffers, each chunk's
@@ -1238,12 +1270,7 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
         fuse_fn, piece_fns, split_fn, piece_shapes = _plan_chunked_programs(
             metas, layout, pset.mesh(), axis, lowered_op, pre, post, n,
             count, bundled, pingpong, piece_donate)
-        if bundled:
-            def canonicalize(ts):
-                return [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
-        else:
-            def canonicalize(ts):
-                return [jnp.asarray(t) for t in ts]
+        canonicalize = _canon_closure(shapes, n, bundled)
         execute = _chunked_execute(fuse_fn, piece_fns, split_fn,
                                    piece_shapes, canonicalize, pingpong)
         return _dispatch.DispatchPlan(name or "grouped_allreduce",
@@ -1259,13 +1286,10 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
                                        post, len(metas), bundled)
     fuse_fn, wire_fn = _plan_fused_programs(metas, smap, n, count, bundled,
                                             donate, row0=bundled)
-    if bundled:
-        def execute(ts):
-            bundles = [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
-            return list(wire_fn(*fuse_fn(*bundles)))
-    else:
-        def execute(ts):
-            return list(wire_fn(*fuse_fn(*[jnp.asarray(t) for t in ts])))
+    canon = _canon_closure(shapes, n, bundled)
+
+    def execute(ts):
+        return list(wire_fn(*fuse_fn(*canon(ts))))
     return _dispatch.DispatchPlan(name or "grouped_allreduce",
                                   "GROUPED_ALLREDUCE", nbytes, negotiate,
                                   execute)
@@ -1304,22 +1328,15 @@ def _build_grouped_broadcast_plan(tensors, sigs, pset: ProcessSet, axis,
     shapes = [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
     src_dts = [jnp.dtype(s[2]) for s in sigs]
     metas = _fusion_metas(shapes, src_dts, src_dts)
-    if bundled:
-        donate = _grouped_donate_mask(
-            metas, lambda i: sigs[i][0] == "b" and len(sigs[i][1]) == 2)
-    else:
-        donate = _grouped_donate_mask(metas, lambda i: len(sigs[i][1]) == 1)
+    donate = _sig_donate_mask(metas, sigs, bundled)
     smap = _grouped_broadcast_smap(pset.mesh(), axis, root_pos, len(metas),
                                    bundled)
     fuse_fn, wire_fn = _plan_fused_programs(metas, smap, n, count, bundled,
                                             donate, row0=False)
-    if bundled:
-        def execute(ts):
-            bundles = [_bundle_of(t, shp, n) for t, shp in zip(ts, shapes)]
-            return list(wire_fn(*fuse_fn(*bundles)))
-    else:
-        def execute(ts):
-            return list(wire_fn(*fuse_fn(*[jnp.asarray(t) for t in ts])))
+    canon = _canon_closure(shapes, n, bundled)
+
+    def execute(ts):
+        return list(wire_fn(*fuse_fn(*canon(ts))))
     negotiate = _plan_group_negotiation(
         "grouped_broadcast", REQ_BROADCAST, name,
         [(shp, jnp.dtype(s[2])) for shp, s in zip(shapes, sigs)], pset,

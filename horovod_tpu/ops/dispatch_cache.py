@@ -37,6 +37,7 @@ flush cheap enough to sit on the async hot path).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable
 
@@ -98,6 +99,42 @@ _invalidations = 0
 _evictions = 0
 _negotiation_skips = 0
 _chunked_builds = 0
+_step_builds = 0
+
+# Where a plan hit was served from: "call" (direct eager collective),
+# "flush" (a fusion-cycle flush coalescing a queue), or "step" (the
+# step capture-and-replay program, ops/step_capture.py). Per-source hit
+# counters keep the overlap/coalesce ratios honest when capture is on —
+# a replayed step serves ONE step-plan hit where the per-flush path
+# would have served one hit per flush.
+_SOURCES = ("call", "flush", "step")
+_hits_by_source = {s: 0 for s in _SOURCES}
+_tls = threading.local()
+
+
+class dispatch_source:
+    """Context manager tagging plan lookups on this thread with their
+    dispatch source (see ``_SOURCES``); the default, untagged source is
+    ``"call"``."""
+
+    __slots__ = ("_source", "_prev")
+
+    def __init__(self, source: str):
+        self._source = source
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "source", None)
+        _tls.source = self._source
+        return self
+
+    def __exit__(self, *exc):
+        _tls.source = self._prev
+        return False
+
+
+def current_source() -> str:
+    return getattr(_tls, "source", None) or "call"
 
 
 def capacity() -> int:
@@ -123,34 +160,58 @@ def _flush_locked(count_invalidation: bool) -> None:
     _plans.clear()
 
 
-def lookup(key: tuple) -> DispatchPlan | None:
+def lookup(key: tuple, source: str | None = None,
+           record_stats: bool = True) -> DispatchPlan | None:
     """Plan for ``key``, or None (miss / caching disabled). Epoch drift
     (re-init, knob override change) flushes before the lookup so a stale
-    plan can never serve."""
+    plan can never serve. ``source`` (default: the thread's ambient
+    :class:`dispatch_source`) tags the hit counter so per-flush and
+    replayed-step hits stay distinguishable. ``record_stats=False`` is
+    for bookkeeping probes (the capture controller's seal/arm checks):
+    the lookup itself stays silent and the hit is counted only when a
+    replay actually serves (:func:`note_step_hit`), so the counters
+    reflect work served, not state-machine traffic."""
     global _hits, _misses, _epoch
     if capacity() <= 0:
         return None
     epoch = _current_epoch()
+    src = source or current_source()
     with _lock:
         if _epoch != epoch:
             _flush_locked(count_invalidation=_epoch is not None)
             _epoch = epoch
         plan = _plans.get(key)
         if plan is None:
-            _misses += 1
+            if record_stats:
+                _misses += 1
             return None
         _plans.move_to_end(key)
         if plan is UNPLANNABLE:
             return plan  # negative decision: neither a hit nor a miss
-        _hits += 1
-    _timeline.record_dispatch(plan.label, hit=True)
+        if record_stats:
+            _hits += 1
+            _hits_by_source[src] = _hits_by_source.get(src, 0) + 1
+    if record_stats:
+        _timeline.record_dispatch(plan.label, hit=True)
     return plan
+
+
+def note_step_hit() -> None:
+    """Count one SERVED step-plan replay (``hits_by_source["step"]``):
+    called by the capture controller when the whole-step program
+    actually executes, so step hits equal replayed steps exactly — an
+    armed-then-diverged step never counts."""
+    global _hits
+    with _lock:
+        _hits += 1
+        _hits_by_source["step"] = _hits_by_source.get("step", 0) + 1
+    _timeline.record_dispatch("step", hit=True)
 
 
 def store(key: tuple, plan: DispatchPlan) -> None:
     """Insert ``plan`` (LRU-evicting past capacity). No-op when caching is
     disabled, so the build-per-call path stays allocation-clean."""
-    global _evictions, _epoch, _chunked_builds
+    global _evictions, _epoch, _chunked_builds, _step_builds
     cap = capacity()
     if cap <= 0:
         return
@@ -158,6 +219,8 @@ def store(key: tuple, plan: DispatchPlan) -> None:
     with _lock:
         if plan is not UNPLANNABLE and plan.variant == "chunked":
             _chunked_builds += 1
+        if plan is not UNPLANNABLE and plan.variant == "step":
+            _step_builds += 1
         if _epoch != epoch:
             _flush_locked(count_invalidation=_epoch is not None)
             _epoch = epoch
@@ -196,20 +259,23 @@ def stats() -> dict:
             "capacity": capacity(),
             "size": len(_plans),
             "hits": _hits,
+            "hits_by_source": dict(_hits_by_source),
             "misses": _misses,
             "invalidations": _invalidations,
             "evictions": _evictions,
             "negotiation_skips": _negotiation_skips,
             "chunked_builds": _chunked_builds,
+            "step_builds": _step_builds,
         }
 
 
 def reset_stats() -> None:
     global _hits, _misses, _invalidations, _evictions, _negotiation_skips
-    global _chunked_builds
+    global _chunked_builds, _step_builds, _hits_by_source
     with _lock:
         _hits = _misses = _invalidations = _evictions = 0
-        _negotiation_skips = _chunked_builds = 0
+        _negotiation_skips = _chunked_builds = _step_builds = 0
+        _hits_by_source = {s: 0 for s in _SOURCES}
 
 
 def reset() -> None:

@@ -102,6 +102,8 @@ from ..utils import envs
 from ..utils import faults as _faults
 from ..utils import invariants as _inv
 from ..utils import logging as hvd_logging
+from . import dispatch_cache as _dispatch_cache
+from . import step_capture as _step_capture
 
 FLUSH_TRIGGERS = ("threshold", "cycle", "synchronize", "poll", "barrier",
                   "join", "shutdown", "backpressure", "name-reuse",
@@ -163,7 +165,7 @@ class _Entry:
 
     __slots__ = ("tensors", "count", "grouped", "nbytes", "names",
                  "requests", "run", "queue_key", "label", "event",
-                 "results", "error")
+                 "results", "error", "sigs", "captured")
 
     def __init__(self, tensors, grouped, nbytes, names, requests=(),
                  run=None, label=""):
@@ -179,6 +181,10 @@ class _Entry:
         self.event = _inv.make_event("fusion_cycle.entry")
         self.results = None
         self.error = None
+        # normalized per-tensor plan signatures (step capture templates);
+        # None = unplannable entry (opaque/sparse), never capturable
+        self.sigs = None
+        self.captured = False  # held by a step-capture replay
 
     @property
     def done(self) -> bool:
@@ -251,6 +257,10 @@ class FusionScheduler:
             "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
             "device_wait_ms": 0.0,
         }
+        # step capture-and-replay controller (HVD_STEP_CAPTURE;
+        # ops/step_capture.py): records the marked step's flush stream,
+        # then replays the whole step as one cached program
+        self.capture = _step_capture.CaptureState(self)
 
     # -- enqueue -----------------------------------------------------------
 
@@ -260,6 +270,12 @@ class FusionScheduler:
         # path it would corrupt flush composition mid-drain.
         _inv.assert_outside("fusion-cycle-flush", "FusionScheduler.enqueue")
         entry.queue_key = key
+        # Step replay intake: a submission matching the armed captured
+        # stream is HELD for the whole-step program instead of queued;
+        # a mismatch falls back to eager transparently (offer returns
+        # False and the entry takes the normal path below).
+        if self.capture.offer(key, spec, entry):
+            return
         if entry.requests:
             # Multi-process entries negotiate the whole flush in ONE
             # negotiate_many batch, whose duplicate-name guard only spans
@@ -352,6 +368,9 @@ class FusionScheduler:
                     with self._exec_cv:
                         self._exec_names.update(svc_names)
         _timeline.record_cycle_flush(trigger)
+        # Step capture recording: composition noted at the drain point
+        # (submission order), while the entries still hold their tensors.
+        self.capture.note_flush(q.spec, entries, trigger)
         if not pipelined:
             self._execute(q.spec, entries)
             return
@@ -386,8 +405,14 @@ class FusionScheduler:
         self._submit(_Batch(q.spec, entries, trigger, ticket))
 
     def flush_entry(self, entry: _Entry, trigger: str) -> None:
-        if not entry.done and entry.queue_key is not None:
-            self.flush_queue(entry.queue_key, trigger)
+        if entry.done or entry.queue_key is None:
+            return
+        # A capture-held entry dispatches with the whole-step program
+        # (or falls back eagerly right here when the trigger blocks
+        # before the stream completed) — never through its queue.
+        if self.capture.intercept_flush(entry, trigger):
+            return
+        self.flush_queue(entry.queue_key, trigger)
 
     def flush_all(self, trigger: str) -> None:
         """Drain every queue in first-enqueue order, then quiesce the
@@ -401,6 +426,8 @@ class FusionScheduler:
             if key is None:
                 break
             self.flush_queue(key, trigger)
+        # a replay caught mid-stream must dispatch its held prefix too
+        self.capture.flush_pending(trigger)
         self.quiesce()
 
     def wait_result(self, entry: _Entry):
@@ -608,7 +635,8 @@ class FusionScheduler:
 
     def _execute(self, spec: _QueueSpec, entries: list[_Entry],
                  ticket=None) -> None:
-        with _inv.section("fusion-cycle-flush"):
+        with _inv.section("fusion-cycle-flush"), \
+                _dispatch_cache.dispatch_source("flush"):
             self._execute_inner(spec, entries, ticket)
 
     def _execute_inner(self, spec: _QueueSpec, entries: list[_Entry],
@@ -847,6 +875,10 @@ class FusionScheduler:
                     e.run = None
                     e.event.set()
                     n += 1
+        # capture-held entries + the recorded/armed plan die with the
+        # world they were recorded against (elastic re-form, service
+        # reset, PeerFailureError teardown)
+        n += self.capture.abort(reason)
         return n
 
     def stop(self) -> None:
@@ -867,6 +899,7 @@ class FusionScheduler:
 
     def stats(self) -> dict:
         slots = max(envs.max_inflight_flushes(), 1)
+        capture = self.capture.stats()
         with self._exec_cv:
             executed = self._pstats["executed"]
             pipeline = {
@@ -933,6 +966,11 @@ class FusionScheduler:
                 "coalesce_ratio": (flushed / dispatches if dispatches
                                    else 0.0),
                 "pipeline": pipeline,
+                # step capture-and-replay lifecycle counters
+                # (docs/step_capture.md). Replayed entries never appear
+                # in dispatches/wire_programs — the per-source plan-hit
+                # split lives in dispatch_cache_stats()["hits_by_source"]
+                "capture": capture,
             }
 
     def reset_stats(self) -> None:
@@ -950,6 +988,7 @@ class FusionScheduler:
                 "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
                 "device_wait_ms": 0.0,
             }
+        self.capture.reset_stats()
 
 
 # ---------------------------------------------------------------------------
@@ -1075,6 +1114,7 @@ def queue_allreduce(tensors, *, grouped: bool, op=None, process_set=None,
     entry = _Entry(list(tensors), grouped,
                    _entry_nbytes(shapes, wire_dts), names, requests,
                    label=names[0])
+    entry.sigs = tuple(sigs)
     scheduler().enqueue(key, spec, entry)
     return _coll._QueuedHandle(entry)
 
@@ -1111,6 +1151,7 @@ def queue_broadcast(tensor, root_rank: int, *, process_set=None, name=None,
                       svc=svc)
     entry = _Entry([tensor], False, _entry_nbytes(shapes, wire_dts), names,
                    requests, label=names[0])
+    entry.sigs = tuple(sigs)
     scheduler().enqueue(key, spec, entry)
     return _coll._QueuedHandle(entry)
 

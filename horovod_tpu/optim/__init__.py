@@ -34,6 +34,7 @@ import optax
 
 from ..ops import collectives
 from ..ops import sparse as sparse_ops
+from ..ops import step_capture
 from ..ops.compression import Compression, Compressor
 from ..ops.reduce_ops import ReduceOp
 from ..process_sets import ProcessSet
@@ -153,22 +154,30 @@ def _bucketed_allreduce(leaves, *, op, process_set, compression,
     buckets = _bucket_layout([_leaf_nbytes(l) for l in leaves], cap)
     if len(buckets) < 2:
         return sync(leaves)
-    handles = []
-    for idxs in buckets:
-        h = collectives.grouped_allreduce_async(
-            [leaves[i] for i in idxs], op=op, process_set=process_set,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, axis_name=axis_name,
-            compression=compression)
-        # dispatch NOW (the "bucket" flush trigger): without this the
-        # bucket would sit queued until a threshold/cycle/synchronize
-        # trigger and nothing would overlap
-        h.flush()
-        handles.append((idxs, h))
-    out = [None] * len(leaves)
-    for idxs, h in handles:
-        for i, r in zip(idxs, h.result()):
-            out[i] = r
+    # Step capture boundary (HVD_STEP_CAPTURE; ops/step_capture.py):
+    # the bucket stream below is submit-then-collect — every bucket is
+    # submitted and flushed before the first result is observed — which
+    # is exactly the shape capture can record once and replay as ONE
+    # whole-step program on later steps. The region is a no-op with the
+    # knob off or when a user `hvd.step_marker()` region already spans
+    # the step.
+    with step_capture.auto_region():
+        handles = []
+        for idxs in buckets:
+            h = collectives.grouped_allreduce_async(
+                [leaves[i] for i in idxs], op=op, process_set=process_set,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, axis_name=axis_name,
+                compression=compression)
+            # dispatch NOW (the "bucket" flush trigger): without this the
+            # bucket would sit queued until a threshold/cycle/synchronize
+            # trigger and nothing would overlap
+            h.flush()
+            handles.append((idxs, h))
+        out = [None] * len(leaves)
+        for idxs, h in handles:
+            for i, r in zip(idxs, h.result()):
+                out[i] = r
     return out
 
 

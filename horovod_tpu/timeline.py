@@ -147,6 +147,19 @@ def record_inflight_depth(depth: int) -> None:
                PHASE_INSTANT)
 
 
+CAPTURE_LANE = "step_capture"
+
+
+def record_capture(event: str) -> None:
+    """Instant ``CAPTURE_<event>`` marker on the ``step_capture`` lane for
+    capture lifecycle transitions (``RECORD``/``SEAL``/``REPLAY``/
+    ``REPLAY_DONE``/``FALLBACK``) so a replayed step — and any transparent
+    fallback to eager — is attributable next to the op ranges
+    (docs/step_capture.md)."""
+    if _active:
+        record(CAPTURE_LANE, f"CAPTURE_{event}", PHASE_INSTANT)
+
+
 def record_retry(what: str, attempt: int) -> None:
     """Instant ``RETRY.<site>.<n>`` marker on the ``health`` lane when a
     retried RPC/KV call backs off (``utils/retry.py``) — a flapping

@@ -52,6 +52,7 @@ GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
 SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # force sparse grads onto dense allreduce
 BUCKET_BYTES = "BUCKET_BYTES"  # gradient bucket size for backward-pass overlap (0 = whole-tree)
 EAGER_CHAIN = "EAGER_CHAIN"  # auto|1|0: let eager consumer math chain on in-flight collective results
+STEP_CAPTURE = "STEP_CAPTURE"  # capture-and-replay of the per-step collective stream (0 = off)
 FLASH_ATTENTION = "FLASH_ATTENTION"  # opt into the Pallas flash kernel
 DEBUG_INVARIANTS = "DEBUG_INVARIANTS"  # dev-mode runtime invariant checker
 SCHED_CHECK = "SCHED_CHECK"  # cooperative schedule-exploration checker (tools/hvdsched)
@@ -262,6 +263,15 @@ DEFAULT_BUCKET_BYTES = 64 * 1024 * 1024
 
 def bucket_bytes() -> int:
     return get_int(BUCKET_BYTES, DEFAULT_BUCKET_BYTES)
+
+
+def step_capture_enabled() -> bool:
+    """Step capture-and-replay (``ops/step_capture.py``): record the
+    marked step's rank-deterministic flush stream once, then replay the
+    whole step's collective work as ONE cached jitted program. Off by
+    default — the eager per-flush path is the reference behavior; the
+    capture plan invalidates transparently on any stream divergence."""
+    return get_bool(STEP_CAPTURE, False)
 
 
 def pipeline_chunking_enabled() -> bool:

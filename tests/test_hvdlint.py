@@ -439,6 +439,72 @@ class TestDonation:
         found = findings_for(tmp_path, "donation", {"ok.py": src})
         assert found == []
 
+    def test_trips_on_step_capture_buffer_read_after_donate(self, tmp_path):
+        # the step capture constructor's wire stage donates EVERY fused
+        # buffer — naming the fuse outputs and reading them after the
+        # wire call is the read-after-donate class the registration of
+        # _plan_step_programs catches
+        src = """
+            def replay(parts, flat):
+                fuse_fn, wire_fn = _plan_step_programs(parts)
+                bufs = fuse_fn(*flat)
+                outs = wire_fn(*bufs)
+                return bufs[0], outs  # bufs was donated into wire_fn
+        """
+        found = findings_for(tmp_path, "donation", {"bad.py": src})
+        assert len(found) == 1
+        assert "'bufs' was donated" in found[0].message
+
+    def test_passes_on_step_capture_inline_composition(self, tmp_path):
+        # the in-tree idiom: the fused buffers never get a name, so no
+        # read-after-donate is possible
+        src = """
+            def replay(parts, flat):
+                fuse_fn, wire_fn = _plan_step_programs(parts)
+                outs = wire_fn(*fuse_fn(*flat))
+                return list(outs)
+        """
+        found = findings_for(tmp_path, "donation", {"ok.py": src})
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# issue-lock x step capture (the un-serialized-jit-in-step_capture class)
+# ---------------------------------------------------------------------------
+
+class TestStepCaptureIssueLock:
+    def test_trips_on_unserialized_step_jit(self, tmp_path):
+        # a whole-step program compiled without the program-issue lock is
+        # exactly the concurrent-enqueue rendezvous-deadlock class PR 3
+        # reproduced — pass 1 must catch it in step_capture-style code
+        src = """
+            import jax
+
+            def _plan_step_programs(parts):
+                fuse_fn = jax.jit(lambda *xs: xs)
+                wire_fn = jax.jit(lambda *xs: xs, donate_argnums=(0,))
+                return fuse_fn, wire_fn
+        """
+        found = findings_for(tmp_path, "issue-lock",
+                             {"step_capture.py": src})
+        assert len(found) == 2
+        assert all("issue_serialized" in f.message for f in found)
+
+    def test_passes_on_serialized_step_jit(self, tmp_path):
+        src = """
+            import jax
+            from .program_issue import issue_serialized as _issue_serialized
+
+            def _plan_step_programs(parts):
+                fuse_fn = _issue_serialized(jax.jit(lambda *xs: xs))
+                wire_fn = _issue_serialized(jax.jit(
+                    lambda *xs: xs, donate_argnums=(0,)))
+                return fuse_fn, wire_fn
+        """
+        found = findings_for(tmp_path, "issue-lock",
+                             {"step_capture.py": src})
+        assert found == []
+
 
 # ---------------------------------------------------------------------------
 # silent-except

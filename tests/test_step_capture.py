@@ -1,0 +1,438 @@
+"""Step capture-and-replay (ISSUE 8 tentpole): record a marked step's
+flush stream once, replay the whole step's collective work as ONE cached
+jitted program, and fall back to eager transparently on any divergence
+(shape/dtype drift, new tensors, mid-step blocking sync, abort/elastic
+re-form, knob-override epoch). Numerics must be identical capture on or
+off, and no fallback path may hang or reuse a stale plan."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu.ops.fusion_cycle as fusion_cycle
+from horovod_tpu.ops import dispatch_cache, step_capture
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.utils import envs
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _capture_env(monkeypatch):
+    # quiet timers: every flush comes from an explicit trigger so the
+    # recorded compositions are deterministic; capture on for the module
+    monkeypatch.setenv("HVD_CYCLE_TIME", "2000")
+    monkeypatch.setenv("HVD_PENDING_CYCLE_TIME", "2000")
+    monkeypatch.setenv("HVD_STEP_CAPTURE", "1")
+    fusion_cycle.reset()
+    dispatch_cache.reset()
+    yield
+    fusion_cycle.reset()
+    dispatch_cache.reset()
+
+
+def _tensors(hvd, shapes, mult=1.0, dtype=jnp.float32):
+    return [hvd.per_rank([jnp.full(shp, (r + 1) * mult * (i + 1), dtype)
+                          for r in range(N)])
+            for i, shp in enumerate(shapes)]
+
+
+def _step(hvd, shapes, mult=1.0, dtype=jnp.float32, compression=None):
+    """One marked step: submit-then-collect over per-tensor flushed
+    async allreduces (the bucketed-optimizer shape capture targets)."""
+    with hvd.step_marker():
+        handles = []
+        for t in _tensors(hvd, shapes, mult, dtype):
+            h = hvd.allreduce_async(t, op=hvd.Sum, compression=compression)
+            h.flush()
+            handles.append(h)
+        return [np.asarray(h.synchronize()) for h in handles]
+
+
+def _capture_stats(hvd):
+    return hvd.fusion_stats()["capture"]
+
+
+# ------------------------------------------------------------ record/replay
+
+def test_record_then_replay_numerics_identical(hvd):
+    shapes = [(64,), (33,), (128,)]
+    ref = _step(hvd, shapes)  # records
+    st = _capture_stats(hvd)
+    assert st["recorded_steps"] == 1
+    assert st["captured_flushes"] == 3
+    assert st["plan_builds"] == 1
+    for k in range(2, 5):
+        out = _step(hvd, shapes)  # replays
+        for a, b, t in zip(ref, out, _tensors(hvd, shapes)):
+            expect = np.sum(np.asarray(t.array), axis=0)
+            assert np.allclose(a, b)
+            assert np.allclose(b, expect)
+    st = _capture_stats(hvd)
+    assert st["replayed_steps"] == 3
+    assert st["replayed_entries"] == 9
+    assert st["fallbacks"] == 0
+
+
+def test_replay_serves_step_plan_hits_with_source_tag(hvd):
+    shapes = [(32,), (32,)]
+    _step(hvd, shapes)
+    flush_hits_after_record = dispatch_cache.stats()["hits_by_source"]["flush"]
+    _step(hvd, shapes)
+    d = dispatch_cache.stats()
+    # the replayed step serves from the step plan, not per-flush plans
+    assert d["hits_by_source"]["step"] >= 1
+    assert d["hits_by_source"]["flush"] == flush_hits_after_record
+    assert d["step_builds"] == 1
+    # replayed entries never count as flush-level dispatches, so the
+    # coalesce ratio isn't silently inflated by capture
+    assert hvd.fusion_stats()["dispatches"] == 2  # the record step's flushes
+
+
+def test_wire_compression_replays_identically(hvd):
+    shapes = [(48,), (16,)]
+    ref = _step(hvd, shapes, compression=Compression.fp16)
+    out = _step(hvd, shapes, compression=Compression.fp16)
+    assert _capture_stats(hvd)["replayed_steps"] == 1
+    for a, b in zip(ref, out):
+        assert np.allclose(a, b)
+
+
+def test_grouped_and_single_mixed_stream(hvd):
+    with hvd.step_marker():
+        g = hvd.grouped_allreduce_async(_tensors(hvd, [(8,), (24,)]),
+                                        op=hvd.Sum)
+        g.flush()
+        s = hvd.allreduce_async(_tensors(hvd, [(40,)])[0], op=hvd.Sum)
+        s.flush()
+        ref = [np.asarray(x) for x in g.synchronize()] \
+            + [np.asarray(s.synchronize())]
+    with hvd.step_marker():
+        g = hvd.grouped_allreduce_async(_tensors(hvd, [(8,), (24,)]),
+                                        op=hvd.Sum)
+        g.flush()
+        s = hvd.allreduce_async(_tensors(hvd, [(40,)])[0], op=hvd.Sum)
+        s.flush()
+        out = [np.asarray(x) for x in g.synchronize()] \
+            + [np.asarray(s.synchronize())]
+    assert _capture_stats(hvd)["replayed_steps"] == 1
+    for a, b in zip(ref, out):
+        assert np.allclose(a, b)
+
+
+# --------------------------------------------------------- invalidation
+
+def test_shape_drift_invalidates_and_falls_back(hvd):
+    _step(hvd, [(64,), (32,)])
+    _step(hvd, [(64,), (32,)])
+    assert _capture_stats(hvd)["replayed_steps"] == 1
+    # shape drift: the second tensor grew — replay must fall back with
+    # correct results, never serve the stale plan
+    out = _step(hvd, [(64,), (48,)])
+    assert out[1].shape == (48,)
+    expect = np.sum(np.asarray(
+        _tensors(hvd, [(64,), (48,)])[1].array), axis=0)
+    assert np.allclose(out[1], expect)
+    st = _capture_stats(hvd)
+    assert st["fallbacks"] >= 1
+    assert st["invalidations"] >= 1
+    # the drifted stream re-captures and replays again
+    _step(hvd, [(64,), (48,)])
+    _step(hvd, [(64,), (48,)])
+    assert _capture_stats(hvd)["replayed_steps"] >= 2
+
+
+def test_dtype_drift_invalidates_and_falls_back(hvd):
+    _step(hvd, [(64,)], dtype=jnp.float32)
+    _step(hvd, [(64,)], dtype=jnp.float32)
+    out = _step(hvd, [(64,)], dtype=jnp.bfloat16)
+    assert out[0].dtype == jnp.bfloat16
+    st = _capture_stats(hvd)
+    assert st["fallbacks"] >= 1
+
+
+def test_extra_tensor_invalidates_and_falls_back(hvd):
+    _step(hvd, [(64,)])
+    _step(hvd, [(64,)])
+    # a NEW tensor appears after the recorded stream completed: the step
+    # already replayed, so the extra submission lands in a completed
+    # region — it must still execute correctly (normal eager path)
+    with hvd.step_marker():
+        h1 = hvd.allreduce_async(_tensors(hvd, [(64,)])[0], op=hvd.Sum)
+        h1.flush()
+        h2 = hvd.allreduce_async(_tensors(hvd, [(7,)])[0], op=hvd.Sum)
+        h2.flush()
+        a = np.asarray(h1.synchronize())
+        b = np.asarray(h2.synchronize())
+    assert np.allclose(b, np.sum(np.asarray(
+        _tensors(hvd, [(7,)])[0].array), axis=0))
+    assert a.shape == (64,)
+
+
+def test_mid_step_synchronize_falls_back_no_hang(hvd):
+    # record: two entries, each drained by its own synchronize
+    with hvd.step_marker():
+        h = hvd.allreduce_async(_tensors(hvd, [(64,)])[0], op=hvd.Sum)
+        r1 = np.asarray(h.synchronize())
+        h = hvd.allreduce_async(_tensors(hvd, [(32,)])[0], op=hvd.Sum)
+        np.asarray(h.synchronize())
+    # replay: the first synchronize BLOCKS before the recorded stream
+    # completed — capture must execute the held prefix eagerly instead
+    # of hanging on a dispatch that would only fire at stream completion
+    with hvd.step_marker():
+        h = hvd.allreduce_async(_tensors(hvd, [(64,)])[0], op=hvd.Sum)
+        out1 = np.asarray(h.synchronize())
+        h = hvd.allreduce_async(_tensors(hvd, [(32,)])[0], op=hvd.Sum)
+        np.asarray(h.synchronize())
+    assert np.allclose(out1, r1)
+    assert _capture_stats(hvd)["fallbacks"] >= 1
+
+
+def test_abort_mid_captured_step_fails_held_entries(hvd):
+    """Elastic re-form / PeerFailureError teardown mid-captured-step:
+    the PR-5 coordinated abort reaches capture-held entries — the waiter
+    unblocks with the abort error (no hang), and the plan is dropped."""
+    _step(hvd, [(64,), (32,)])  # record
+    sched = fusion_cycle.scheduler()
+    with hvd.step_marker():
+        h = hvd.allreduce_async(_tensors(hvd, [(64,)])[0], op=hvd.Sum)
+        h.flush()  # held by the armed replay
+        n = sched.abort("peer rank 3 failed: PeerFailureError")
+        assert n >= 1
+        with pytest.raises(RuntimeError, match="aborted"):
+            h.synchronize()
+    st = _capture_stats(hvd)
+    assert st["invalidations"] >= 1
+    # the next marked step re-records against the new world
+    ref = _step(hvd, [(64,), (32,)])
+    out = _step(hvd, [(64,), (32,)])
+    for a, b in zip(ref, out):
+        assert np.allclose(a, b)
+
+
+def test_knob_override_epoch_invalidates_plan(hvd):
+    _step(hvd, [(64,)])
+    _step(hvd, [(64,)])
+    assert _capture_stats(hvd)["replayed_steps"] == 1
+    builds = _capture_stats(hvd)["plan_builds"]
+    # a knob override bumps the envs epoch: the dispatch cache flushes,
+    # dropping the step plan — the next step re-records, never replays
+    # a plan built under the old knob state
+    envs.set_override(envs.FUSION_THRESHOLD, 1 << 22)
+    try:
+        out = _step(hvd, [(64,)])
+        assert np.allclose(out[0], np.sum(np.asarray(
+            _tensors(hvd, [(64,)])[0].array), axis=0))
+        st = _capture_stats(hvd)
+        assert st["invalidations"] >= 1
+        assert st["plan_builds"] == builds + 1  # re-captured
+        _step(hvd, [(64,)])
+        assert _capture_stats(hvd)["replayed_steps"] == 2
+    finally:
+        envs.clear_override(envs.FUSION_THRESHOLD)
+
+
+def test_barrier_mid_step_drains_held_entries(hvd):
+    _step(hvd, [(64,), (32,)])
+    with hvd.step_marker():
+        # only the first of the two recorded submissions has arrived:
+        # the held prefix must dispatch at the barrier-style drain
+        h = hvd.allreduce_async(_tensors(hvd, [(64,), (32,)])[0],
+                                op=hvd.Sum)
+        h.flush()
+        hvd.fusion_flush()  # barrier-style drain mid-replay
+        out = np.asarray(h.synchronize())
+    assert np.allclose(out, np.sum(np.asarray(
+        _tensors(hvd, [(64,)])[0].array), axis=0))
+    assert _capture_stats(hvd)["fallbacks"] >= 1
+
+
+# ---------------------------------------------------- determinism parity
+
+def test_two_scheduler_capture_key_parity(hvd, monkeypatch):
+    """The PR-2/3 determinism contract extended to capture: two
+    schedulers fed the identical stream seal byte-identical capture
+    keys (auto-generated negotiation names — global counters — are
+    excluded from the key by design)."""
+    def capture_key(sched):
+        monkeypatch.setattr(fusion_cycle, "_scheduler", sched)
+        _step(__import__("horovod_tpu"), [(64,), (32,), (9,)])
+        key = sched.capture._last_key
+        sched.stop()
+        return key
+
+    key_a = capture_key(fusion_cycle.FusionScheduler())
+    key_b = capture_key(fusion_cycle.FusionScheduler())
+    assert key_a is not None
+    assert key_a == key_b
+    assert repr(key_a) == repr(key_b)  # byte-identical
+
+
+def test_uncapturable_stream_stays_eager(hvd):
+    shapes = [(16,)]
+    for _ in range(3):
+        with hvd.step_marker():
+            h = hvd.allreduce_async(_tensors(hvd, shapes)[0], op=hvd.Sum)
+            h.flush()
+            g = hvd.allgather_async(jnp.ones((4,), jnp.float32))
+            out = np.asarray(h.synchronize())
+            gathered = np.asarray(g.synchronize())
+        assert gathered.shape == (4 * N,)
+        assert np.allclose(out, np.sum(np.asarray(
+            _tensors(hvd, shapes)[0].array), axis=0))
+    st = _capture_stats(hvd)
+    assert st["replayed_steps"] == 0
+    assert st["uncapturable_steps"] >= 1
+
+
+def test_empty_region_keeps_plan_armed(hvd):
+    # a marked region with no collectives (e.g. an eval iteration
+    # between train steps) must not invalidate the capture — the next
+    # non-empty step re-arms and replays
+    shapes = [(64,), (32,)]
+    _step(hvd, shapes)            # record
+    with hvd.step_marker():
+        pass                      # empty eval region
+    out = _step(hvd, shapes)      # must REPLAY, not re-record
+    st = _capture_stats(hvd)
+    assert st["replayed_steps"] == 1, st
+    assert st["recorded_steps"] == 1, st
+    assert st["fallbacks"] == 0, st
+    expect = np.sum(np.asarray(_tensors(hvd, shapes)[0].array), axis=0)
+    assert np.allclose(out[0], expect)
+
+
+def test_cache_disabled_skips_recording(hvd, monkeypatch):
+    # HVD_CACHE_CAPACITY=0: a sealed plan could never be stored, so
+    # capture must stay eager instead of re-recording every step
+    monkeypatch.setenv("HVD_CACHE_CAPACITY", "0")
+    fusion_cycle.reset()
+    ref = _step(hvd, [(64,)])
+    out = _step(hvd, [(64,)])
+    st = _capture_stats(hvd)
+    assert st["recorded_steps"] == 0
+    assert st["plan_builds"] == 0
+    assert st["replayed_steps"] == 0
+    assert np.allclose(ref[0], out[0])
+
+
+def test_svc_duplicate_names_seal_uncapturable():
+    """A user name repeated within one step needs the eager path's
+    name-reuse serialization (two sequential negotiation batches);
+    replay's single negotiate_step round would orphan the first request
+    — such a stream must seal as uncapturable, never replay-and-hang."""
+    sched = fusion_cycle.FusionScheduler()
+    cap = sched.capture
+
+    class _Svc:
+        pass
+
+    svc = _Svc()
+    spec = fusion_cycle._QueueSpec("allreduce", None, None, svc=svc)
+    sig = (("r", (4,), "float32"),)
+    dup = [
+        step_capture._FlushRecord(spec, [step_capture._EntryTemplate(
+            ("k",), False, 1, sig, names=("grad",))], "bucket"),
+        step_capture._FlushRecord(spec, [step_capture._EntryTemplate(
+            ("k",), False, 1, sig, names=("grad",))], "bucket"),
+    ]
+    assert cap._default_build_plan(("key",), dup) is None
+    unique = [
+        step_capture._FlushRecord(spec, [step_capture._EntryTemplate(
+            ("k",), False, 1, sig, names=("grad.0",))], "bucket"),
+        step_capture._FlushRecord(spec, [step_capture._EntryTemplate(
+            ("k",), False, 1, sig, names=("grad.1",))], "bucket"),
+    ]
+    plan = cap._default_build_plan(("key",), unique)
+    assert isinstance(plan, step_capture.StepPlan)
+    sched.stop()
+
+
+def test_negotiate_step_batches_one_round_and_counts():
+    """The whole-step batched negotiation seam: one negotiate_many round
+    for the whole request list, counted on the service."""
+    from horovod_tpu.engine_service import DynamicService
+    svc = DynamicService.__new__(DynamicService)
+    svc.step_negotiations = 0
+    rounds = []
+
+    def fake_many(reqs, timeout=None):
+        rounds.append(len(reqs))
+        return ["resp"] * len(reqs)
+
+    svc.negotiate_many = fake_many
+    out = svc.negotiate_step([{"name": "a"}, {"name": "b"},
+                              {"name": "c"}])
+    assert rounds == [3]  # ONE round for the whole step
+    assert svc.step_negotiations == 1
+    assert len(out) == 3
+
+
+def test_capture_disabled_is_inert(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_STEP_CAPTURE", "0")
+    fusion_cycle.reset()
+    ref = _step(hvd, [(64,)])
+    out = _step(hvd, [(64,)])
+    st = _capture_stats(hvd)
+    assert st["recorded_steps"] == 0
+    assert st["replayed_steps"] == 0
+    assert np.allclose(ref[0], out[0])
+
+
+# ------------------------------------------------- optimizer integration
+
+def test_distributed_optimizer_capture_parity(hvd, monkeypatch):
+    """End-to-end: the bucketed DistributedOptimizer sync marks its own
+    capture region — params after 3 steps are identical capture on/off,
+    and steps 2-3 replay."""
+    monkeypatch.setenv("HVD_BUCKET_BYTES", "2048")
+
+    def run(capture_on):
+        monkeypatch.setenv("HVD_STEP_CAPTURE", "1" if capture_on else "0")
+        fusion_cycle.reset()
+        dispatch_cache.reset()
+        params = {
+            "a": jnp.ones((300,), jnp.float32),
+            "b": {"w": jnp.full((500,), 2.0, jnp.float32)},
+            "c": jnp.full((200,), 3.0, jnp.float32),
+        }
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        opt = tx.init(params)
+        for step in range(3):
+            grads = {
+                "a": hvd.per_rank([jnp.full((300,), (r + 1) * 0.01 * (step + 1),
+                                            jnp.float32) for r in range(N)]),
+                "b": {"w": hvd.per_rank([jnp.full((500,), (r + 1) * 0.02,
+                                                  jnp.float32)
+                                         for r in range(N)])},
+                "c": hvd.per_rank([jnp.full((200,), (r + 1) * 0.03,
+                                            jnp.float32) for r in range(N)]),
+            }
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+        import jax
+        stats = hvd.fusion_stats()["capture"]
+        return [np.asarray(l) for l in jax.tree.leaves(params)], stats
+
+    off_params, _ = run(False)
+    on_params, on_stats = run(True)
+    assert on_stats["recorded_steps"] == 1
+    assert on_stats["replayed_steps"] == 2
+    assert on_stats["fallbacks"] == 0
+    for a, b in zip(off_params, on_params):
+        assert np.allclose(a, b)
+
+
+def test_step_marker_context_manager_closes_region(hvd):
+    with hvd.step_marker():
+        h = hvd.allreduce_async(_tensors(hvd, [(64,)])[0], op=hvd.Sum)
+        h.flush()
+        h.synchronize()
+    cap = fusion_cycle.scheduler().capture
+    assert not cap.region_open()
+    # a flush outside any region is not recorded
+    h = hvd.allreduce_async(_tensors(hvd, [(64,)])[0], op=hvd.Sum)
+    h.synchronize()
+    assert _capture_stats(hvd)["recorded_steps"] == 1
+    assert _capture_stats(hvd)["captured_flushes"] == 1

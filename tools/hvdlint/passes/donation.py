@@ -19,7 +19,9 @@ What counts as a donating callable:
   expression conservatively donates every position);
 * results of the project's donating-program constructors, tracked
   through tuple unpacking: ``_plan_fused_programs`` (wire stage donates
-  all args), ``_plan_chunked_programs`` (fuse stage donates arg 0 under
+  all args), ``_plan_step_programs`` (the step capture-and-replay twin:
+  its wire stage donates the whole step's fused buffers), and
+  ``_plan_chunked_programs`` (fuse stage donates arg 0 under
   ping-pong; the per-piece programs donate arg 0), and the
   ``donate=``-parameterized cached constructors
   (``_eager_grouped_allreduce_fn`` / ``_eager_grouped_broadcast_fn`` /
@@ -50,6 +52,9 @@ _WRAPPERS = ("issue_serialized", "_issue_serialized", "functools.lru_cache")
 #   marks a list of callables each with `spec`.
 CONSTRUCTORS = {
     "_plan_fused_programs": (None, ALL),
+    # step capture (ops/step_capture.py): (fuse_fn, wire_fn) where the
+    # wire stage takes every record's fused buffers donated
+    "_plan_step_programs": (None, ALL),
     "_plan_chunked_programs": (frozenset({0}), ("list", frozenset({0})),
                                None, None),
     "_eager_grouped_allreduce_fn": "donate-kwarg",

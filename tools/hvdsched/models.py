@@ -213,6 +213,74 @@ def watchdog_poison_abort():
         raise AssertionError("watchdog decision event without an outcome")
 
 
+def capture_replay_abort():
+    """Step capture lifecycle racing producers, the flush executor, and
+    abort(): a record->seal transition while enqueues land concurrently,
+    then a replay step whose held entries race an abort() mid-stream.
+    Contract: every entry settles (replayed/fallback result if its step
+    won the race, abort error otherwise), no boundary or waiter can
+    hang. The plan constructor is stubbed (pure Python — no XLA
+    programs) so exploration drives the real CaptureState lock/handoff
+    structure, not device compute."""
+    inv, fc = _inv(), _fusion()
+    from horovod_tpu.ops import dispatch_cache, step_capture
+    dispatch_cache.reset()
+    sched = fc.FusionScheduler()
+    cap = sched.capture
+    cap.force_enabled = True
+    built = [0]
+
+    def stub_build(key, records):
+        built[0] += 1
+
+        def run_step(entries_per_record):
+            return [[("replayed", e.label) for e in entries
+                     for _ in range(e.count)]
+                    for entries in entries_per_record]
+        return step_capture.StepPlan(key, records, run_step, 0,
+                                     len(records))
+
+    cap._build_plan = stub_build
+    entries: list = []
+
+    def stream(i, phase):
+        spec = _sparse_spec(fc)
+        for j in range(2):
+            e = _opaque(fc, f"cap{phase}.{i}.{j}", value=(i, j))
+            entries.append(e)
+            sched.enqueue(("sparse", f"k{i}"), spec, e)
+        sched.flush_queue(("sparse", f"k{i}"), "threshold")
+
+    # record step: the recording flushes race the producers' enqueues
+    cap.boundary()
+    ts = [inv.spawn_thread(stream, name=f"rec-{i}", args=(i, 0))
+          for i in (1, 2)]
+    for t in ts:
+        inv.join_thread(t)
+    sched.flush_all("barrier")
+    # boundary seals the recording and arms replay; the replay stream
+    # then races an abort() — entries settle as replayed results, eager
+    # fallbacks, or abort errors depending on the schedule
+    cap.boundary()
+    if built[0] != 1 or cap._state != "replay":
+        raise AssertionError(
+            "model precondition broken: the seal must build the stub "
+            f"plan and arm replay (built={built[0]}, state={cap._state!r})"
+            " — without an armed replay this model explores nothing")
+    ts = [inv.spawn_thread(stream, name=f"rep-{i}", args=(i, 1))
+          for i in (1, 2)]
+    ts.append(inv.spawn_thread(
+        lambda: sched.abort("chaos: simulated reset mid-replay"),
+        name="aborter"))
+    for t in ts:
+        inv.join_thread(t)
+    cap.boundary(closing=True)
+    sched.flush_all("shutdown")
+    _assert_settled(entries)
+    sched.stop()
+    dispatch_cache.reset()
+
+
 # -- the PR-3 rendezvous shape (guarded = current code's issue lock) --------
 
 def _rendezvous_model(guarded: bool):
@@ -394,6 +462,7 @@ MATRIX = {
     "flush-abort": flush_abort_race,
     "quiesce-race": quiesce_enqueue_race,
     "watchdog-abort": watchdog_poison_abort,
+    "capture-replay-abort": capture_replay_abort,
     "pr3-issue-lock": pr3_issue_lock,
     "pr6-chain-guard": pr6_chain_guard,
 }
