@@ -56,7 +56,7 @@ rm -f "$lint_json"
 # backend") are now SKIPPED via tests/backend_markers.py, so the dot
 # count is a clean signal. Raise this when the environment's pass level
 # rises; override with T1_MIN_PASSED.
-T1_MIN_PASSED="${T1_MIN_PASSED:-590}"
+T1_MIN_PASSED="${T1_MIN_PASSED:-621}"
 
 step "1/6 tier-1 gate (the ROADMAP.md command; floor: $T1_MIN_PASSED passed)"
 # faulthandler_timeout: a hung test (e.g. a flush-executor deadlock) dumps
@@ -174,6 +174,17 @@ step "1j/6 schedule-exploration gate (hvdsched race matrix; docs/schedule_checke
 # replay line.
 HVD_SCHED_CHECK=1 timeout -k 10 300 python -m tools.hvdsched --schedules 200
 HVD_SCHED_CHECK=1 timeout -k 10 300 python -m tools.hvdsched --demos --schedules 120
+
+step "1l/6 loopback chaos gate (world=4 rank death under HVD_DEBUG_INVARIANTS=1; docs/loopback.md)"
+# The loopback world's failure-domain acceptance (ISSUE 10): an
+# HVD_FAULT_SPEC rank death at world=4 must surface PeerFailureError on
+# every survivor in < 5 s (watchdog silence detection over the shared
+# KV), and a mid-elastic-run death must drive blacklist + re-form to a
+# completed job. Runs with the concurrency witness on: a coordinated
+# abort that corrupts lock order across the rank threads fails here.
+env HVD_DEBUG_INVARIANTS=1 timeout -k 10 600 \
+  python -m pytest tests/test_loopback_world.py::TestChaos -q \
+    -o faulthandler_timeout=300
 
 step "1k/6 step capture-and-replay bench (whole-step replay must beat the per-flush path)"
 # End-to-end eager DP transformer step: HVD_STEP_CAPTURE on (step 1

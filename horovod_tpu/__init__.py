@@ -128,6 +128,7 @@ from . import callbacks
 from . import checkpoint
 from . import data
 from . import elastic
+from . import loopback
 from . import parallel
 from .callbacks import average_metrics, metric_average
 from .version import __version__
@@ -172,6 +173,7 @@ __all__ = [
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
     "PeerFailureError", "health_stats",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
-    "checkpoint", "data", "elastic", "parallel", "average_metrics",
+    "checkpoint", "data", "elastic", "loopback", "parallel",
+    "average_metrics",
     "metric_average", "SyncBatchNorm", "__version__",
 ]

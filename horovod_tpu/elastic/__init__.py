@@ -70,9 +70,10 @@ def run(func):
         if rdv is not None:
             # A worker spawned for round R must ignore the notification that
             # announced R — it is already a member of that round.
-            from .notification import notification_manager
-            notification_manager.register_listener(state)
-            notification_manager.mark_round_joined(rdv.round)
+            from .notification import get_notification_manager
+            manager = get_notification_manager()
+            manager.register_listener(state)
+            manager.mark_round_joined(rdv.round)
             rdv.record_ready()
         result = wrapped(state, *args, **kwargs)
         if rdv is not None:

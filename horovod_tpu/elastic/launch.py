@@ -53,8 +53,29 @@ def run_elastic(args, command: list[str]) -> int:
         k, _, v = assignment.partition("=")
         extra_base[k] = v
 
+    lb_world = None
+    if getattr(args, "loopback", False):
+        # Elastic over rank THREADS: same driver/registry/rendezvous,
+        # loopback spawner (docs/loopback.md).
+        import sys as _sys
+
+        from ..loopback import engine as lb_engine
+        np_cap = max_np or args.np or min_np
+        lb_engine._seed_xla_device_flags(np_cap)
+        lb_world = lb_engine.LoopbackWorld(
+            kv_addr="127.0.0.1", kv_port=infra.kv_port, secret=infra.secret)
+        lb_body, lb_argv = lb_engine.script_body(command)
+        _sys.argv = lb_argv
+
     def create_worker_fn(slot_info: hosts_mod.SlotInfo, spec_round: int):
         spec = infra.round_spec(spec_round)
+        if lb_world is not None:
+            env = lb_engine.elastic_worker_env(
+                slot_info, spec, "127.0.0.1", infra.kv_port, infra.secret,
+                spec_round, extra=extra_base)
+            return lb_world.spawn(
+                lb_body, env,
+                name=f"{slot_info.hostname}[{slot_info.local_rank}]")
         all_local = all(
             launch_mod.is_local_host(s["hostname"]) for s in spec["slots"])
         env = launch_mod.worker_env(
@@ -73,6 +94,8 @@ def run_elastic(args, command: list[str]) -> int:
         results = driver.get_results()
     finally:
         infra.stop()
+        if lb_world is not None:
+            lb_world.shutdown()
 
     if results.error_message:
         print(f"hvdrun elastic: {results.error_message}", file=sys.stderr)

@@ -51,9 +51,9 @@ class WorkerNotificationManager:
                                      secret=envs.get(envs.SECRET_KEY))
             self._client = kv_client
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._poll_loop, daemon=True, name="hvd-elastic-notify")
-            self._thread.start()
+            from ..utils import invariants as _inv
+            self._thread = _inv.spawn_thread(
+                self._poll_loop, name="hvd-elastic-notify")
 
     def register_listener(self, listener):
         with self._lock:
@@ -108,3 +108,17 @@ class WorkerNotificationManager:
 
 
 notification_manager = WorkerNotificationManager()
+
+
+def get_notification_manager() -> WorkerNotificationManager:
+    """The worker-side notification manager — per loopback rank context
+    on rank threads (listeners are per-worker elastic States; a shared
+    manager would deliver one rank's interrupts to every rank), else the
+    process-wide singleton."""
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    if ctx is not None:
+        if ctx.notification_manager is None:
+            ctx.notification_manager = WorkerNotificationManager()
+        return ctx.notification_manager
+    return notification_manager

@@ -137,11 +137,26 @@ class WorkerRendezvous:
         import jax
 
         from .. import runtime
+        from ..loopback import context as _lbctx
 
         hvd_logging.info(
             "re-rendezvous into round %d: rank %d/%d via %s:%d",
             spec["round"], my_slot["rank"], spec["world_size"],
             spec["coord_addr"], spec["coord_port"])
+
+        if _lbctx.current() is not None:
+            # Loopback rank thread: no jax.distributed world exists (the
+            # XLA backend is shared and untouched) — tear down this
+            # rank's services, seed the new round's contract into the
+            # rank overlay, and rebuild the loopback runtime in place.
+            runtime.shutdown()
+            self._seed_round_env(spec, my_slot)
+            self.round = spec["round"]
+            runtime.init()
+            from .notification import get_notification_manager
+            get_notification_manager().mark_round_joined(self.round)
+            self.record_ready()
+            return
 
         runtime.shutdown()  # also stops the old-world negotiation service
         jax.config.update("jax_enable_recoverability", True)
@@ -163,6 +178,18 @@ class WorkerRendezvous:
         jex_backend.clear_backends()
         jax.clear_caches()
 
+        self._seed_round_env(spec, my_slot)
+
+        self.round = spec["round"]
+        runtime.init()
+        from .notification import get_notification_manager
+        get_notification_manager().mark_round_joined(self.round)
+        self.record_ready()
+
+    @staticmethod
+    def _seed_round_env(spec: dict, my_slot: dict) -> None:
+        """Seed the new round's worker contract (into the loopback rank
+        overlay on rank threads, else the process env)."""
         env = {
             envs.RANK: my_slot["rank"],
             envs.SIZE: spec["world_size"],
@@ -178,17 +205,20 @@ class WorkerRendezvous:
         for name, value in env.items():
             envs.set_env(name, value)
 
-        self.round = spec["round"]
-        runtime.init()
-        from .notification import notification_manager
-        notification_manager.mark_round_joined(self.round)
-        self.record_ready()
-
 
 _worker_rendezvous: WorkerRendezvous | None = None
 
 
 def get_worker_rendezvous() -> WorkerRendezvous:
+    """The per-worker rendezvous handle — per loopback rank context on
+    rank threads (each rank is its own elastic worker), else the
+    process-wide singleton."""
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    if ctx is not None:
+        if ctx.worker_rendezvous is None:
+            ctx.worker_rendezvous = WorkerRendezvous()
+        return ctx.worker_rendezvous
     global _worker_rendezvous
     if _worker_rendezvous is None:
         _worker_rendezvous = WorkerRendezvous()

@@ -200,10 +200,11 @@ class JaxState(ObjectState):
 def run_fn(func, reset):
     """Wrap ``func(state, ...)`` in the elastic recover loop (reference
     ``run_fn``, ``elastic.py:151-174``)."""
-    from .notification import notification_manager
+    from .notification import get_notification_manager
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        notification_manager = get_notification_manager()
         notification_manager.init()
         notification_manager.register_listener(state)
         skip_sync = False

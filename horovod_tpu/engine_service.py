@@ -28,6 +28,8 @@ import time
 
 from . import health as _health
 from . import timeline as _timeline
+from .loopback import context as _lbctx
+from .utils import invariants as _inv
 from .dynamic import (
     HorovodCollectiveError,
     NativeEngine,
@@ -174,9 +176,11 @@ class DynamicService:
                 # the elastic driver blacklists the right host.
                 global_ranks=global_ranks)
             self._watchdog.start()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="hvd-engine-cycle")
-        self._thread.start()
+        # Through the invariants seam: hvdsched can serialize the cycle
+        # thread, and a loopback rank's cycle thread inherits that
+        # rank's context (joined-rank zero executions run on it).
+        self._thread = _inv.spawn_thread(self._loop,
+                                         name="hvd-engine-cycle")
 
     # -- public ------------------------------------------------------------
 
@@ -386,7 +390,13 @@ class DynamicService:
         self._tick.set()  # the adaptive sleep waits on _tick, not _shutdown
         if self._watchdog is not None:
             self._watchdog.stop()
-        self._thread.join(timeout=10)
+        # Short join: a cycle thread parked in the KV gather long-poll
+        # (waiting for peers that are also shutting down) can take the
+        # full server-side wait to notice; it is a daemon and _fail_all
+        # below settles every waiter, so teardown must not serialize on
+        # it (loopback worlds stop one service per rank — a long join
+        # here multiplies across the world).
+        _inv.join_thread(self._thread, timeout=2)
         self._fail_all("engine service stopped")
 
     def health_watchdog(self) -> _health.HealthWatchdog | None:
@@ -559,6 +569,34 @@ _service_lock = threading.Lock()
 _service_unavailable = False  # infra-level: knob off / no KV / no native
 
 
+class _ServiceScope:
+    """Resolution of the per-world service table: a loopback rank thread
+    owns ITS rank's services (one ``DynamicService`` per rank per set —
+    N ranks in one interpreter means N global-set services negotiating
+    with each other over the shared KV); everything else shares the
+    process-wide table."""
+
+    __slots__ = ("table", "ctx")
+
+    def __init__(self):
+        self.ctx = _lbctx.current()
+        self.table = self.ctx.services if self.ctx is not None else _services
+
+    @property
+    def unavailable(self) -> bool:
+        if self.ctx is not None:
+            return self.ctx.service_unavailable
+        return _service_unavailable
+
+    @unavailable.setter
+    def unavailable(self, value: bool) -> None:
+        global _service_unavailable
+        if self.ctx is not None:
+            self.ctx.service_unavailable = value
+        else:
+            _service_unavailable = value
+
+
 def _set_key(pset) -> str:
     """Stable cross-process key for a process set: registered id when
     available, else a digest of the rank list (deterministic everywhere,
@@ -575,18 +613,18 @@ def get_service(pset=None) -> DynamicService | None:
     """The negotiation service for ``pset`` (default: global set), or None
     when not applicable (single-process job, this process not a member,
     knob disabled, no launcher KV, native engine unavailable)."""
-    global _service_unavailable
-    if _service_unavailable:
+    scope = _ServiceScope()
+    if scope.unavailable:
         return None
     if not envs.get_bool(envs.DYNAMIC_ENGINE, True):
-        _service_unavailable = True
+        scope.unavailable = True
         return None
     from . import runtime
     if not runtime.is_initialized() or runtime.process_count() <= 1:
         return None  # may become multi-process after a later init
     kv_addr = envs.get(envs.KV_ADDR)
     if not kv_addr:
-        _service_unavailable = True
+        scope.unavailable = True
         return None
 
     if pset is None or pset.is_global:
@@ -598,17 +636,18 @@ def get_service(pset=None) -> DynamicService | None:
     if me not in member_procs or len(member_procs) <= 1:
         return None
     key = _set_key(pset)
-    svc = _services.get(key)
+    services = scope.table
+    svc = services.get(key)
     if svc is not None:
         return svc
     with _service_lock:
-        svc = _services.get(key)
-        if svc is not None or _service_unavailable:
+        svc = services.get(key)
+        if svc is not None or scope.unavailable:
             return svc
         try:
             from ._native import available
             if not available():
-                _service_unavailable = True
+                scope.unavailable = True
                 return None
             from .runner.http_kv import KVClient
             kv = KVClient(kv_addr, envs.get_int(envs.KV_PORT, 0),
@@ -626,19 +665,20 @@ def get_service(pset=None) -> DynamicService | None:
                                     member_procs.index(me), prefix=prefix)
             svc = DynamicService(engine, transport,
                                  global_ranks=member_procs)
-            _services[key] = svc
+            services[key] = svc
             hvd_logging.info(
                 "dynamic engine service started for set %s: %d processes "
                 "over KV %s", key, len(member_procs), kv_addr)
         except Exception as e:
             hvd_logging.warning("dynamic engine service unavailable: %s", e)
-            _service_unavailable = True
+            scope.unavailable = True
     return svc
 
 
 def reset_service() -> None:
-    """Tear down all per-set services (elastic re-init / tests)."""
-    global _service_unavailable
+    """Tear down all per-set services (elastic re-init / tests). On a
+    loopback rank thread this tears down THAT rank's services only."""
+    scope = _ServiceScope()
     # Entries still queued in the fusion cycle pinned THIS world's
     # services and negotiation names — they can never execute after the
     # reset. Fail them (handles raise at synchronize) instead of leaving
@@ -652,15 +692,15 @@ def reset_service() -> None:
             "(synchronize their handles before shutdown/reset to land "
             "them)", aborted)
     with _service_lock:
-        for svc in _services.values():
+        for svc in scope.table.values():
             svc.stop()
-        _services.clear()
-        _service_unavailable = False
+        scope.table.clear()
+        scope.unavailable = False
     # Auto-generated op names must restart from zero everywhere after a
     # world reset: surviving workers would otherwise keep counting while
     # replacement workers start at 0, desynchronizing negotiation names.
     from .ops import collectives as _coll
-    _coll._auto_counters.clear()
+    _coll._reset_auto_counters()
     # Dispatch plans pin their negotiation decision (service object + the
     # stable tensor names) — all stale after a service teardown.
     from .ops import dispatch_cache

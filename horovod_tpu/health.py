@@ -138,10 +138,13 @@ class HealthWatchdog:
             self._loop, name=f"hvd-health-{self.rank}")
         _register(self)
 
-    def stop(self) -> None:
+    def stop(self, join: bool = True) -> None:
+        """Stop beating. ``join=False`` is the loopback crash path: the
+        dying rank must cease beats NOW without waiting out a beat in
+        flight — the in-process analog of a process death."""
         self._stop.set()
         t = self._thread
-        if t is not None and t is not threading.current_thread():
+        if join and t is not None and t is not threading.current_thread():
             _inv.join_thread(t, timeout=5)
         self._thread = None
         _unregister(self)

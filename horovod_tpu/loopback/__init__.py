@@ -1,0 +1,40 @@
+"""Loopback multi-rank world: the full world>1 stack in ONE interpreter.
+
+``hvd.loopback.world(n)`` boots *n* ranks as threads inside the current
+process: each rank gets its own runtime context (rank/size/process-set
+table, its own negotiation ``DynamicService`` + ``FusionScheduler`` +
+health watchdog), all ranks share one in-process HTTP KV server and the
+real ``KVTransport``/``engine_service`` negotiation wire format, and
+collective *execution* is emulated on the virtual-device CPU mesh by a
+loopback dispatch backend (:mod:`horovod_tpu.loopback.dispatch`) that
+rendezvouses the ranks' bundles and computes the reduction through the
+very same compiled single-controller programs — numerics identical to
+the world=1 path by construction. jax-0.4's "Multiprocess computations
+aren't implemented on the CPU backend" never triggers because no
+cross-process XLA program is ever built.
+
+See docs/loopback.md for the architecture, what is emulated vs real,
+and the fidelity limits vs a true multi-process world.
+
+This ``__init__`` stays import-light on purpose: ``loopback.context``
+is imported from low-level modules (``utils/envs.py``,
+``utils/invariants.py``, ``runtime.py``) during package init, so the
+heavy pieces (world, dispatch) load lazily on first attribute access.
+"""
+
+from __future__ import annotations
+
+from . import context  # stdlib-only; safe during package init
+from .context import RankContext, RankKilled, current
+
+__all__ = [
+    "LoopbackWorld", "RankContext", "RankKilled", "current",
+    "elastic_run", "world",
+]
+
+
+def __getattr__(name):
+    if name in ("world", "LoopbackWorld", "elastic_run"):
+        from . import engine as _engine
+        return getattr(_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

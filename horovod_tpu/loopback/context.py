@@ -1,0 +1,139 @@
+"""Per-rank loopback context: the thread-local seam every runtime module
+consults before falling back to its process-wide state.
+
+A :class:`RankContext` is the loopback analog of "one worker process":
+it carries the rank's environment overlay (the launcher env contract —
+``HVD_RANK``/``HVD_KV_*``/... — without touching ``os.environ``, which
+all ranks share), its runtime state (built by ``runtime.init()``'s
+loopback branch), its negotiation-service table, its fusion scheduler,
+its dispatch-plan store, and its auto-name counters. The modules that
+own the corresponding process-wide singletons check
+:func:`current` first, so code running on a rank thread — or any thread
+*spawned from* one through ``utils.invariants.spawn_thread`` — sees the
+rank's world instead of the process's.
+
+Deliberately stdlib-only: this module is imported from
+``utils/envs.py`` and ``utils/invariants.py`` during package init, so
+it must not pull in jax or any sibling runtime module.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_tls = threading.local()
+
+
+class RankKilled(BaseException):
+    """A fault-injected ``crash`` on a loopback rank thread: the
+    in-process stand-in for ``os._exit`` (which would take the whole
+    interpreter — i.e. every rank — down). BaseException so user-level
+    ``except Exception`` blocks in the training body cannot swallow a
+    simulated process death."""
+
+    def __init__(self, code: int = 1):
+        super().__init__(f"loopback rank killed (exit code {code})")
+        self.code = code
+
+
+class RankContext:
+    """One loopback rank's world view. Created by
+    :class:`~horovod_tpu.loopback.world.LoopbackWorld`; populated by the
+    loopback branches of ``runtime.init()`` / ``engine_service`` /
+    ``fusion_cycle`` / ``dispatch_cache`` as the rank runs."""
+
+    __slots__ = (
+        "world", "rank", "name", "env", "dead", "main_thread",
+        # runtime.py loopback state
+        "runtime_state", "generation",
+        # engine_service.py per-rank service table
+        "services", "service_unavailable",
+        # ops/fusion_cycle.py per-rank scheduler
+        "scheduler",
+        # ops/dispatch_cache.py per-rank plan store
+        "plans", "plan_epoch",
+        # ops/collectives.py per-rank auto-name counters
+        "auto_counters",
+        # loopback/dispatch.py per-rank exchange occurrence counters
+        "xseq",
+        # elastic worker-side singletons (per rank, not per process)
+        "notification_manager", "worker_rendezvous",
+    )
+
+    def __init__(self, world, rank: int, env: dict | None = None,
+                 name: str = ""):
+        self.world = world
+        self.rank = rank
+        self.name = name or f"loopback-rank-{rank}"
+        self.env: dict[str, str] = dict(env or {})
+        self.dead = False
+        self.main_thread = None  # the rank's body thread (engine._worker)
+        self.runtime_state = None
+        self.generation = 0
+        self.services: dict = {}
+        self.service_unavailable = False
+        self.scheduler = None
+        self.plans = None  # OrderedDict, created lazily by dispatch_cache
+        self.plan_epoch = None
+        self.auto_counters: dict = {}
+        self.xseq: dict = {}
+        self.notification_manager = None
+        self.worker_rendezvous = None
+
+    def check_alive(self) -> None:
+        if self.dead:
+            raise RankKilled()
+
+    def __repr__(self):
+        return f"<RankContext {self.name} rank={self.rank} dead={self.dead}>"
+
+
+def current() -> RankContext | None:
+    """The loopback context bound to the calling thread, or None (the
+    normal process-wide world)."""
+    return getattr(_tls, "ctx", None)
+
+
+class activate:
+    """Bind ``ctx`` to the current thread for the with-block (re-entrant:
+    the previous binding is restored on exit)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: RankContext | None):
+        self._ctx = ctx
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self._prev
+        return False
+
+
+def bind_current(fn):
+    """Wrap ``fn`` so it runs under the *spawning* thread's context —
+    the propagation rule for every thread created through
+    ``utils.invariants.spawn_thread`` (scheduler timer, flush executor,
+    negotiation cycle, watchdog): a component owned by a rank keeps
+    seeing that rank's world from its own threads. No-op wrapper when
+    the spawning thread has no context."""
+    ctx = current()
+    if ctx is None:
+        return fn
+
+    def run(*args, **kwargs):
+        with activate(ctx):
+            try:
+                return fn(*args, **kwargs)
+            except SystemExit:
+                # silent thread exit: the loopback crash teardown ends a
+                # rank-owned helper thread this way (a thread of a dead
+                # process just stops — no unhandled-exception hook)
+                return None
+
+    run.__name__ = getattr(fn, "__name__", "bound")
+    return run

@@ -1,0 +1,145 @@
+"""Loopback dispatch backend: emulated collective execution.
+
+The execution half of the loopback world (negotiation is the *real*
+``engine_service`` protocol over the real HTTP KV — nothing there is
+emulated). Every eager collective in a multi-rank job funnels, post-
+negotiation, through a handful of bundle-execution choke points in
+``ops/collectives.py`` (``_execute_allreduce_bundle``,
+``_execute_grouped_bundles``, the allgather/broadcast/alltoall/
+reducescatter eager bodies, and the joined-rank zero reconstruction).
+Under a loopback context those choke points call :func:`channel`:
+each rank contributes *its own row* of the ``(n, ...)`` bundle, the hub
+rendezvouses the rows under the globally-agreed negotiation tensor name
+(unique while in flight; per-name occurrence counters disambiguate
+steady-state reuse), and the completing rank runs the caller-supplied
+compute — the unmodified single-controller program over the
+reconstructed true bundle on the shared virtual-device mesh. Every rank
+returns the identical result object, so numerics match the world=1 path
+bit-for-bit.
+
+Why rows instead of programs: a raw (local) tensor enters the bundle
+path as ``broadcast_to(local, (n, ...))`` — every row equals the local
+value — while a user-built ``PerRank`` bundle already carries the true
+rows. Taking row ``pset position`` is correct for both, and a joined
+rank's zero bundle contributes a zero row, which is exactly the
+reference JoinOp semantics.
+"""
+
+from __future__ import annotations
+
+from . import context as _ctx
+from ..utils import envs
+
+DEFAULT_LOOPBACK_TIMEOUT_S = 120.0
+
+
+def _timeout_s() -> float:
+    return envs.get_float(envs.LOOPBACK_TIMEOUT, DEFAULT_LOOPBACK_TIMEOUT_S)
+
+
+def active() -> bool:
+    """Whether the calling thread runs inside an initialized loopback
+    rank (plan builders pick loopback execute closures here; plans are
+    per-context, so the choice can never leak across worlds)."""
+    ctx = _ctx.current()
+    return ctx is not None and ctx.runtime_state is not None
+
+
+class Channel:
+    """One rank's handle on one collective execution's rendezvous: the
+    slot identity (scope + name + occurrence), this rank's position in
+    the process set, and the failure probe that turns a watchdog-
+    detected peer death into a prompt error on parked waiters."""
+
+    __slots__ = ("hub", "slot_id", "pos", "count", "_failure_check")
+
+    def __init__(self, hub, slot_id, pos, count, failure_check):
+        self.hub = hub
+        self.slot_id = slot_id
+        self.pos = pos
+        self.count = count
+        self._failure_check = failure_check
+
+    def compute(self, payload, fn):
+        """Exchange ``payload`` (this rank's row/rows) and return
+        ``fn(ordered_payloads)`` computed once by the completing rank."""
+        return self.hub.exchange_compute(
+            self.slot_id, self.pos, self.count, payload, fn,
+            timeout=_timeout_s(), failure_check=self._failure_check)
+
+    def gather(self, payload) -> list:
+        """Exchange ``payload`` and return every rank's, in set order."""
+        return self.hub.exchange(
+            self.slot_id, self.pos, self.count, payload,
+            timeout=_timeout_s(), failure_check=self._failure_check)
+
+
+def _failure_probe(ctx, pset):
+    """Failure check evaluated while parked on a slot: the rank's own
+    death (fault-injected kill) or its negotiation service's coordinated
+    abort (health watchdog: peer death / poison)."""
+    from .. import engine_service
+
+    def check():
+        if ctx.dead:
+            return _ctx.RankKilled()
+        svc = ctx.services.get(engine_service._set_key(pset))
+        if svc is not None and svc._failure:
+            return svc._failure_error()
+        return None
+
+    return check
+
+
+def channel(pset, name) -> Channel | None:
+    """The loopback channel for one collective execution over ``pset``
+    keyed by negotiation tensor ``name`` — or None when execution should
+    take the normal path (no loopback context, world not up, a
+    single-member set, or no name to pair on)."""
+    ctx = _ctx.current()
+    if ctx is None or ctx.runtime_state is None or ctx.world is None:
+        return None
+    ranks = tuple(pset.ranks)
+    if len(ranks) <= 1 or not name:
+        return None
+    from .. import engine_service, runtime
+    pos = pset.rank(runtime.rank())
+    if pos < 0:
+        return None
+    ctx.check_alive()
+    scope = (envs.get(envs.COORDINATOR_ADDR, "local"),
+             envs.get(envs.COORDINATOR_PORT, "0"),
+             engine_service._set_key(pset), ranks)
+    seq_key = (scope, str(name))
+    occurrence = ctx.xseq.get(seq_key, 0)
+    ctx.xseq[seq_key] = occurrence + 1
+    slot_id = scope + (str(name), occurrence)
+    return Channel(ctx.world.hub, slot_id, pos, len(ranks),
+                   _failure_probe(ctx, pset))
+
+
+# ---------------------------------------------------------------------------
+# process-level object collectives (broadcast_object / allgather_object):
+# the loopback stand-in for jax's multihost_utils, which needs a real
+# multi-process backend. Calls are rank-deterministic program points
+# (elastic state sync / host-update checks), paired by a per-scope call
+# counter.
+# ---------------------------------------------------------------------------
+
+def object_channel() -> Channel | None:
+    ctx = _ctx.current()
+    if ctx is None or ctx.runtime_state is None or ctx.world is None:
+        return None
+    from .. import runtime
+    n = runtime.process_count()
+    if n <= 1:
+        return None
+    ctx.check_alive()
+    scope = ("obj", envs.get(envs.COORDINATOR_ADDR, "local"),
+             envs.get(envs.COORDINATOR_PORT, "0"))
+    occurrence = ctx.xseq.get(scope, 0)
+    ctx.xseq[scope] = occurrence + 1
+    slot_id = scope + (occurrence,)
+    from ..process_sets import global_process_set
+    return Channel(ctx.world.hub, slot_id, runtime.process_rank(), n,
+                   _failure_probe(ctx, global_process_set))

@@ -1,0 +1,498 @@
+"""Loopback world engine: N ranks as threads in one interpreter.
+
+``world(n)`` (exported as ``hvd.loopback.world``) boots *n* rank threads,
+each bound to its own :class:`~horovod_tpu.loopback.context.RankContext`
+carrying the launcher env contract (``HVD_RANK``/``HVD_KV_*``/...) as a
+per-thread overlay. ``runtime.init()`` on a rank thread takes its
+loopback branch: a per-rank runtime state (rank/size/process-set table)
+over the shared virtual-device CPU mesh, a per-rank negotiation
+``DynamicService`` speaking the real ``KVTransport`` wire format against
+this world's in-process HTTP KV server, a per-rank ``FusionScheduler``,
+and a per-rank health watchdog. Collective execution rendezvouses
+through the world's :class:`~horovod_tpu.loopback.hub.LoopbackHub`
+(see ``loopback/dispatch.py``).
+
+The elastic path (:func:`elastic_run`, ``hvdrun --loopback --min-np``)
+reuses the REAL elastic driver, registry, rendezvous and discovery —
+only ``create_worker_fn`` changes: workers are rank threads instead of
+processes, with ``wait()/poll()/terminate()`` handles the driver
+supervises exactly like subprocesses. A fault-injected ``crash`` on a
+rank thread raises :class:`~horovod_tpu.loopback.context.RankKilled`,
+the rank's services stop beating (abrupt teardown — the in-process
+analog of a process death), survivors' watchdogs detect the silence,
+and the driver blacklists + re-forms the round.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import traceback
+
+from . import context as _ctx
+from .hub import LoopbackHub
+from ..utils import envs
+from ..utils import invariants as _inv
+from ..utils import logging as hvd_logging
+
+_world_ids = itertools.count(1)
+
+
+class WorldTimeout(RuntimeError):
+    """A loopback rank thread did not finish within the run deadline."""
+
+
+class Outcome:
+    """Per-rank result of one loopback run: the body's return value, the
+    exception that ended it (if any), and the process-exit-code analog
+    the elastic driver supervises (0 ok, 66 slot-lost, crash code)."""
+
+    __slots__ = ("rank", "result", "error", "exit_code")
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.result = None
+        self.error: BaseException | None = None
+        self.exit_code: int | None = None
+
+    def __repr__(self):
+        return (f"Outcome(rank={self.rank}, exit_code={self.exit_code}, "
+                f"error={self.error!r})")
+
+
+class RankThread:
+    """Worker handle with the subprocess supervision surface the elastic
+    driver expects (``wait``/``poll``/``terminate``)."""
+
+    def __init__(self, world, ctx: _ctx.RankContext, thread: threading.Thread,
+                 outcome: Outcome):
+        self.world = world
+        self.ctx = ctx
+        self.thread = thread
+        self.outcome = outcome
+
+    def poll(self):
+        if self.thread.is_alive():
+            return None
+        return self.outcome.exit_code if self.outcome.exit_code is not None \
+            else 1
+
+    def wait(self):
+        self.thread.join()
+        return self.poll()
+
+    def terminate(self):
+        """Driver-side kill of a stale/straggling worker: mark the rank
+        dead and fail its in-flight negotiation waits so the thread
+        unwinds promptly (it cannot be force-killed like a process)."""
+        if not self.thread.is_alive():
+            return
+        _abrupt_stop(self.ctx, reason="worker terminated by driver")
+
+
+def _abrupt_stop(ctx: _ctx.RankContext, reason: str,
+                 exc: BaseException | None = None) -> None:
+    """The in-process analog of a worker process dying: stop the rank's
+    liveness beats and negotiation cycles WITHOUT a graceful drain, so
+    peers observe exactly what a real death looks like (silence on the
+    health channel), while the dying rank's own waiters unblock instead
+    of leaking parked threads. ``exc`` (the crash path passes
+    ``RankKilled``) becomes the error those waiters raise, so the rank's
+    main thread unwinds as killed even when the crash site was a helper
+    thread."""
+    ctx.dead = True
+    sched = ctx.scheduler
+    if sched is not None:
+        try:
+            sched.abort(reason)
+            sched.stop()
+        except Exception:
+            hvd_logging.exception("loopback: scheduler teardown failed")
+    for svc in list(ctx.services.values()):
+        try:
+            wd = svc.health_watchdog()
+            if wd is not None:
+                wd.stop(join=False)  # beats cease; no poison published
+            svc._shutdown.set()
+            svc._tick.set()
+            svc._fail_all(reason, exc)
+        except Exception:
+            hvd_logging.exception("loopback: service teardown failed")
+    ctx.services.clear()
+    nm, ctx.notification_manager = ctx.notification_manager, None
+    if nm is not None:
+        try:
+            nm.shutdown()  # stop the per-rank elastic notify poller
+        except Exception:
+            hvd_logging.exception(
+                "loopback: notification teardown failed")
+
+
+def _worker(world, ctx: _ctx.RankContext, fn, out: Outcome,
+            auto_init: bool) -> None:
+    from .. import runtime
+    killed = False
+    ctx.main_thread = threading.current_thread()
+    with _ctx.activate(ctx):
+        try:
+            if auto_init:
+                runtime.init()
+            out.result = fn()
+            out.exit_code = 0
+        except SystemExit as e:
+            # sys.exit on a rank thread (elastic slot-lost self-exit):
+            # record the code like a process exit would carry it
+            code = e.code
+            out.exit_code = code if isinstance(code, int) else \
+                (0 if code is None else 1)
+        except _ctx.RankKilled as e:
+            out.error = e
+            out.exit_code = e.code
+            killed = True
+        except BaseException as e:
+            out.error = e
+            out.exit_code = 1
+        finally:
+            try:
+                if killed or ctx.dead:
+                    _abrupt_stop(ctx, reason="loopback rank killed")
+                else:
+                    runtime.shutdown()
+                    nm, ctx.notification_manager = \
+                        ctx.notification_manager, None
+                    if nm is not None:
+                        nm.shutdown()  # per-rank elastic notify poller
+            except BaseException:
+                hvd_logging.exception(
+                    "loopback rank %s teardown failed", ctx.name)
+
+
+class LoopbackWorld:
+    """One loopback world: the shared rendezvous hub, the (owned or
+    external) KV server, and the rank-thread spawner."""
+
+    def __init__(self, size: int | None = None, *, extra_env=None,
+                 kv_addr: str | None = None, kv_port: int | None = None,
+                 secret: str | None = None, name: str | None = None):
+        from .. import _native
+        if not _native.available():
+            raise RuntimeError(
+                "loopback world needs the native negotiation engine "
+                "(horovod_tpu._native); build it first")
+        self.size = size
+        self.name = name or f"lbw{next(_world_ids)}"
+        self.hub = LoopbackHub(self.name)
+        self._round = 0
+        self._extra_env = dict(extra_env or {})
+        self._kv_server = None
+        if kv_addr is None:
+            from ..runner.http_kv import KVServer, make_secret
+            self._secret = make_secret()
+            self._kv_server = KVServer(secret=self._secret)
+            self._kv_port = self._kv_server.start()
+            self._kv_addr = "127.0.0.1"
+        else:
+            self._kv_addr = kv_addr
+            self._kv_port = int(kv_port or 0)
+            self._secret = secret
+        self._handles: list[RankThread] = []
+
+    # -- env contract ------------------------------------------------------
+
+    def rank_env(self, rank: int, size: int, *, extra=None) -> dict:
+        """The launcher-seeded worker env contract, as a per-thread
+        overlay (``runner/launch.worker_env`` analog for rank threads)."""
+        env = {
+            "HVD_LOOPBACK": "1",
+            "HVD_RANK": str(rank),
+            "HVD_SIZE": str(size),
+            "HVD_LOCAL_RANK": "0",
+            "HVD_LOCAL_SIZE": "1",
+            "HVD_CROSS_RANK": str(rank),
+            "HVD_CROSS_SIZE": str(size),
+            "HVD_PROCESS_ID": str(rank),
+            "HVD_NUM_PROCESSES": str(size),
+            "HVD_COORDINATOR_ADDR": self.name,
+            "HVD_COORDINATOR_PORT": str(self._round),
+            "HVD_KV_ADDR": self._kv_addr,
+            "HVD_KV_PORT": str(self._kv_port),
+            "HVD_HOSTNAME": f"{self.name}-host{rank}",
+        }
+        if self._secret is not None:
+            env["HVD_SECRET_KEY"] = self._secret
+        env.update(self._extra_env)
+        env.update(extra or {})
+        return env
+
+    # -- spawning ----------------------------------------------------------
+
+    def spawn(self, fn, env: dict, *, auto_init: bool = False,
+              name: str | None = None) -> RankThread:
+        # prune finished handles: a long elastic run re-forms many
+        # rounds, and pinning every dead rank's context/result for the
+        # world's lifetime is a leak proportional to rounds x world
+        self._handles = [h for h in self._handles if h.thread.is_alive()]
+        rank = int(env.get("HVD_RANK", -1))
+        ctx = _ctx.RankContext(self, rank, env=env,
+                               name=name or f"{self.name}-rank{rank}")
+        out = Outcome(rank)
+        thread = threading.Thread(
+            target=_worker, args=(self, ctx, fn, out, auto_init),
+            daemon=True, name=ctx.name)
+        handle = RankThread(self, ctx, thread, out)
+        self._handles.append(handle)
+        thread.start()
+        return handle
+
+    def run(self, fn, *, timeout: float | None = 300.0,
+            allow_failures: bool = False, extra_env=None) -> list[Outcome]:
+        """Run ``fn()`` on every rank of a fresh static round (each rank
+        auto-``init()``s its loopback runtime first; ``fn`` may call
+        ``hvd.init()`` again harmlessly). Returns per-rank
+        :class:`Outcome`\\ s; unless ``allow_failures``, the first rank
+        error re-raises. ``timeout=None`` supervises without a deadline
+        (the launcher path — a training job runs as long as it runs)."""
+        n = self.size
+        if not n or n < 1:
+            raise ValueError("LoopbackWorld.run needs a world size")
+        _check_devices(n)
+        self._round += 1
+        handles = [self.spawn(fn, self.rank_env(r, n, extra=extra_env),
+                              auto_init=True) for r in range(n)]
+        if timeout is None:
+            for h in handles:
+                h.thread.join()
+        else:
+            deadline = _inv.monotonic() + timeout
+            for h in handles:
+                h.thread.join(max(deadline - _inv.monotonic(), 0.1))
+        stuck = [h for h in handles if h.thread.is_alive()]
+        if stuck:
+            dump = _thread_stacks({h.thread.ident: h.ctx.name
+                                   for h in stuck})
+            self.hub.fail_all(WorldTimeout("loopback world timed out"))
+            for h in stuck:
+                _abrupt_stop(h.ctx, reason="loopback run timeout")
+            for h in stuck:
+                h.thread.join(5.0)
+            raise WorldTimeout(
+                f"loopback ranks {[h.ctx.name for h in stuck]} did not "
+                f"finish within {timeout:g}s; stacks:\n{dump}")
+        outs = [h.outcome for h in handles]
+        if not allow_failures:
+            for o in outs:
+                if o.error is not None:
+                    raise o.error
+        return outs
+
+    def shutdown(self) -> None:
+        self.hub.fail_all(RuntimeError("loopback world shut down"))
+        for h in self._handles:
+            if h.thread.is_alive():
+                _abrupt_stop(h.ctx, reason="loopback world shut down")
+        for h in self._handles:
+            h.thread.join(5.0)
+        if self._kv_server is not None:
+            self._kv_server.stop()
+            self._kv_server = None
+
+
+def _seed_xla_device_flags(n: int) -> None:
+    """Force >= ``n`` virtual CPU devices. XLA reads ``XLA_FLAGS`` at
+    BACKEND INITIALIZATION (the first ``jax.devices()`` call), not at
+    jax import — the launcher imports jax transitively, so seeding here
+    still works as long as no backend is live yet."""
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _check_devices(n: int) -> None:
+    import jax
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"loopback world of {n} needs {n} XLA devices but only "
+            f"{len(devs)} exist; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} (or more) "
+            "BEFORE the first jax import")
+
+
+def _thread_stacks(idents: dict) -> str:
+    frames = sys._current_frames()
+    chunks = []
+    for ident, name in idents.items():
+        frame = frames.get(ident)
+        if frame is not None:
+            chunks.append(f"--- {name}\n"
+                          + "".join(traceback.format_stack(frame)))
+    return "\n".join(chunks)
+
+
+class world:
+    """``with hvd.loopback.world(n) as w: w.run(body)`` — the loopback
+    twin of ``hvdrun -np n``. Also usable as a plain constructor-and-
+    shutdown pair in fixtures."""
+
+    def __init__(self, size: int, **kwargs):
+        self._world = LoopbackWorld(size, **kwargs)
+
+    def __enter__(self) -> LoopbackWorld:
+        return self._world
+
+    def __exit__(self, *exc):
+        self._world.shutdown()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# elastic: the real driver over rank threads
+# ---------------------------------------------------------------------------
+
+def elastic_run(fn, *, np: int, min_np: int | None = None,
+                max_np: int | None = None, discovery=None,
+                extra_env=None, timeout: float | None = None,
+                reset_limit: int | None = None):
+    """Run an elastic loopback job: the REAL ``ElasticDriver`` + registry
+    + rendezvous + discovery, with workers as loopback rank threads.
+    ``fn`` is the worker body (the full "script": it calls ``hvd.init()``
+    and typically ``hvd.elastic.run``). Returns ``(results, succeeded)``
+    mirroring ``elastic/launch.run_elastic``'s decision inputs."""
+    from ..elastic.bootstrap import make_elastic_infra
+    from ..runner.launch import _free_port
+
+    infra = make_elastic_infra(
+        discovery, min_np or np, max_np, timeout=timeout,
+        reset_limit=reset_limit,
+        # Loopback "hosts" are labels, not machines: a free local port
+        # stands in for the per-host coordinator endpoint probe (the
+        # coordinator address is only a service-prefix discriminator
+        # here — no jax.distributed world is ever built).
+        remote_port_probe=lambda host: _free_port())
+    w = LoopbackWorld(kv_addr=infra.kv_addr, kv_port=infra.kv_port,
+                      secret=infra.secret)
+    driver = infra.driver
+    base_env = dict(extra_env or {})
+
+    def create_worker_fn(slot_info, spec_round: int):
+        spec = infra.round_spec(spec_round)
+        env = elastic_worker_env(slot_info, spec, infra.kv_addr,
+                                 infra.kv_port, infra.secret, spec_round,
+                                 extra=base_env)
+        return w.spawn(
+            fn, env, auto_init=False,
+            name=f"{w.name}-{slot_info.hostname}[{slot_info.local_rank}]")
+
+    try:
+        _check_devices(max_np or np)
+        driver.start(np, create_worker_fn)
+        driver.join()
+        results = driver.get_results()
+        succeeded = driver.succeeded
+    finally:
+        infra.stop()
+        w.shutdown()
+    return results, succeeded
+
+
+def elastic_worker_env(slot_info, spec: dict, kv_addr: str, kv_port: int,
+                       secret: str, spec_round: int, extra=None) -> dict:
+    """The elastic worker env contract as a rank-thread overlay — the
+    loopback twin of ``runner/launch.worker_env`` +
+    ``ElasticInfra.worker_extra_env``."""
+    env = {
+        "HVD_LOOPBACK": "1",
+        "HVD_RANK": str(slot_info.rank),
+        "HVD_SIZE": str(slot_info.size),
+        "HVD_LOCAL_RANK": str(slot_info.local_rank),
+        "HVD_LOCAL_SIZE": str(slot_info.local_size),
+        "HVD_CROSS_RANK": str(slot_info.cross_rank),
+        "HVD_CROSS_SIZE": str(slot_info.cross_size),
+        "HVD_PROCESS_ID": str(slot_info.rank),
+        "HVD_NUM_PROCESSES": str(slot_info.size),
+        "HVD_COORDINATOR_ADDR": str(spec["coord_addr"]),
+        "HVD_COORDINATOR_PORT": str(spec["coord_port"]),
+        "HVD_KV_ADDR": kv_addr,
+        "HVD_KV_PORT": str(kv_port),
+        "HVD_SECRET_KEY": secret,
+        "HVD_HOSTNAME": slot_info.hostname,
+        "HVD_ELASTIC": "1",
+        "HVD_ELASTIC_ROUND": str(spec_round),
+    }
+    env.update(extra or {})
+    return env
+
+
+# ---------------------------------------------------------------------------
+# hvdrun --loopback: run a worker SCRIPT on every rank thread
+# ---------------------------------------------------------------------------
+
+def script_body(command: list[str]):
+    """``(body, argv)`` for a training command: the rank-thread body
+    executing the script (or ``python -m module``) via runpy, and the
+    ``sys.argv`` the scripts should see. ``sys.argv`` is process-global,
+    so the caller sets it once; module imports are shared across ranks —
+    scripts must tolerate that (see docs/loopback.md, fidelity limits)."""
+    if not command:
+        raise ValueError("loopback launch: empty command")
+    import re
+    rest = list(command)
+    base = rest[0].rsplit("/", 1)[-1]
+    # interpreter detection matches python/pythonN[.M] exactly — a
+    # directly-executable script that merely STARTS with "python"
+    # (python_tool.py) is the training script, not an interpreter
+    if re.fullmatch(r"python\d*(\.\d+)?", base) or rest[0] == sys.executable:
+        rest = rest[1:]
+        if not rest:
+            raise ValueError(
+                "loopback launch: expected a script after the interpreter")
+    if rest[0] == "-m":
+        if len(rest) < 2:
+            raise ValueError(
+                "loopback launch: expected a module after -m")
+        module, argv = rest[1], rest[1:]
+
+        def body():
+            import runpy
+            runpy.run_module(module, run_name="__main__", alter_sys=False)
+    else:
+        path, argv = rest[0], rest
+
+        def body():
+            import runpy
+            runpy.run_path(path, run_name="__main__")
+
+    return body, argv
+
+
+def run_command(args, command: list[str]) -> int:
+    """The ``hvdrun --loopback`` static path: one interpreter, ``np``
+    rank threads each executing the command's script."""
+    np_ = args.np or 1
+    _seed_xla_device_flags(np_)
+    body, argv = script_body(command)
+    sys.argv = argv
+    w = LoopbackWorld(np_)
+    try:
+        # no run deadline: the launcher supervises a training job like
+        # the process path's unbounded p.wait() (--start-timeout bounds
+        # job START in the process launcher, never total runtime)
+        outs = w.run(body, timeout=None, allow_failures=True)
+    finally:
+        w.shutdown()
+    for o in outs:
+        if o.error is not None:
+            print(f"hvdrun --loopback: rank {o.rank} failed:",
+                  file=sys.stderr)
+            traceback.print_exception(type(o.error), o.error,
+                                      o.error.__traceback__)
+    bad = {o.rank: o.exit_code for o in outs if (o.exit_code or 0) != 0}
+    if bad:
+        print(f"hvdrun --loopback: worker failure, exit codes by rank: "
+              f"{bad}", file=sys.stderr)
+        return next(iter(bad.values()), 1)
+    return 0

@@ -1,0 +1,190 @@
+"""In-process rendezvous hub: where the N loopback ranks' bundles meet.
+
+One :class:`LoopbackHub` per loopback world. Every emulated collective
+execution is one *slot*: each participating rank posts its contribution
+under a slot id that is identical on every rank — the globally-agreed
+negotiation tensor name plus a per-name occurrence counter (names are
+unique while in flight, and every rank uses a name's k-th occurrence in
+the same order, both guaranteed by the negotiation protocol) — and
+blocks until all participants have posted. The rank whose post completes
+the set (the *leader*) computes the result **once**, outside the hub
+lock, by running the very same compiled single-controller program the
+world=1 path uses over the reconstructed ``(n, ...)`` bundle; every
+participant then returns the identical result object. Numerics are
+therefore identical to the world=1 path by construction, not by
+re-implementation.
+
+Failure semantics: waits poll a caller-provided ``failure_check`` (the
+rank's negotiation-service failure state, fed by the health watchdog)
+so a peer death surfaces as :class:`~horovod_tpu.exceptions.
+PeerFailureError` within the watchdog budget instead of the full
+exchange deadline; :meth:`fail_all` poisons every pending slot at world
+teardown. Slots are reference-counted and deleted once every
+participant consumed the result.
+
+All blocking goes through the ``utils/invariants.py`` constructor seam,
+so the whole rendezvous is explorable and replayable under
+``HVD_SCHED_CHECK=1`` (tools/hvdsched — the ``loopback-exchange``
+model) and witness-checked under ``HVD_DEBUG_INVARIANTS=1``.
+"""
+
+from __future__ import annotations
+
+from ..utils import invariants as _inv
+
+# Wait-slice while parked on a slot: short enough that a failure_check
+# hit (watchdog-detected peer death) surfaces promptly, long enough not
+# to spin. Virtualized under HVD_SCHED_CHECK.
+_WAIT_SLICE_S = 0.2
+
+
+class ExchangeTimeout(RuntimeError):
+    """A loopback exchange did not complete within its deadline — the
+    in-process analog of the negotiation exchange timeout (some rank
+    never issued the matching collective)."""
+
+
+class _Slot:
+    __slots__ = ("values", "count", "computing", "done", "result",
+                 "error", "consumed")
+
+    def __init__(self, count: int):
+        self.values: dict[int, object] = {}
+        self.count = count
+        self.computing = False
+        self.done = False
+        self.result = None
+        self.error: BaseException | None = None
+        self.consumed = 0
+
+
+class LoopbackHub:
+    def __init__(self, name: str = "loopback"):
+        self._cv = _inv.make_condition(f"{name}.hub.cv")
+        self._slots: dict[tuple, _Slot] = {}
+        self._failure: BaseException | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def fail_all(self, exc: BaseException) -> None:
+        """Poison every pending (and future) slot: world teardown or an
+        unrecoverable rank failure. Parked waiters raise ``exc``; they
+        hold direct slot references, so the registry can drop the slots
+        immediately (payload tensors must not outlive the failure)."""
+        with self._cv:
+            self._failure = exc
+            for slot in self._slots.values():
+                if not slot.done:
+                    slot.error = exc
+                    slot.done = True
+            self._slots.clear()
+            self._cv.notify_all()
+
+    # -- the rendezvous primitive ------------------------------------------
+
+    def exchange_compute(self, slot_id: tuple, pos: int, count: int,
+                         payload, compute, *, timeout: float,
+                         failure_check=None):
+        """Post ``payload`` as participant ``pos`` of ``count`` under
+        ``slot_id``; when all participants posted, the completing rank
+        runs ``compute([payload_0, ..., payload_{count-1}])`` once and
+        every participant returns its result. ``compute`` runs with no
+        hub lock held (it issues compiled mesh programs)."""
+        deadline = _inv.monotonic() + timeout
+        lead = False
+        with self._cv:
+            self._raise_poisoned()
+            slot = self._slots.get(slot_id)
+            if slot is None:
+                slot = _Slot(count)
+                self._slots[slot_id] = slot
+            if pos in slot.values or slot.count != count:
+                raise RuntimeError(
+                    f"loopback exchange {slot_id!r}: duplicate or "
+                    f"mismatched participation (pos {pos}, count {count} "
+                    f"vs {slot.count}) — collective streams diverged "
+                    "across ranks")
+            slot.values[pos] = payload
+            if len(slot.values) == count:
+                slot.computing = True
+                lead = True
+                ordered = [slot.values[p] for p in sorted(slot.values)]
+            self._cv.notify_all()
+        if lead:
+            result = None
+            error = None
+            try:
+                result = compute(ordered)
+            except BaseException as e:
+                error = e
+            with self._cv:
+                slot.result = result
+                slot.error = error
+                slot.done = True
+                self._cv.notify_all()
+            return self._consume(slot_id, slot)
+        with self._cv:
+            while not slot.done:
+                exc = failure_check() if failure_check is not None else None
+                if exc is not None:
+                    # the slot may still complete for the other waiters;
+                    # this participant gives up with the failure it saw
+                    self._abandon_locked(slot_id, slot)
+                    raise exc
+                remaining = deadline - _inv.monotonic()
+                if remaining <= 0 and not slot.computing:
+                    self._abandon_locked(slot_id, slot)
+                    # timeout applies to MISSING participants only: once
+                    # every rank posted and the leader is computing (a
+                    # first-call compile can be slow under load), the
+                    # collective WILL complete or error — keep waiting
+                    missing = sorted(set(range(count)) - set(slot.values))
+                    raise ExchangeTimeout(
+                        f"loopback exchange {slot_id!r} timed out after "
+                        f"{timeout:g}s waiting for participants {missing} "
+                        "(a rank never issued the matching collective, "
+                        "or died before the watchdog noticed)")
+                self._cv.wait(_WAIT_SLICE_S if remaining <= 0
+                              else min(remaining, _WAIT_SLICE_S))
+        return self._consume(slot_id, slot)
+
+    def exchange(self, slot_id: tuple, pos: int, count: int, payload, *,
+                 timeout: float, failure_check=None) -> list:
+        """Plain allgather: every participant returns the ordered list of
+        all payloads (no leader computation)."""
+        return self.exchange_compute(slot_id, pos, count, payload,
+                                     lambda vals: list(vals),
+                                     timeout=timeout,
+                                     failure_check=failure_check)
+
+    # -- internals ---------------------------------------------------------
+
+    def _raise_poisoned(self) -> None:
+        if self._failure is not None:
+            raise self._failure
+
+    def _abandon_locked(self, slot_id: tuple, slot: _Slot) -> None:
+        """A waiter gives up (peer death / timeout): count it as consumed
+        and drop the slot once every KNOWN poster has given up — a dead
+        rank never posts, so waiting for ``count`` consumptions would pin
+        the posted payload tensors for the world's lifetime. A live-but-
+        slow participant arriving later recreates the slot, times out
+        against the already-failed world, and cleans up the same way."""
+        slot.consumed += 1
+        threshold = slot.count if slot.done else len(slot.values)
+        if slot.consumed >= threshold:
+            self._slots.pop(slot_id, None)
+
+    def _consume(self, slot_id: tuple, slot: _Slot):
+        with self._cv:
+            slot.consumed += 1
+            if slot.consumed >= slot.count:
+                self._slots.pop(slot_id, None)
+            error, result = slot.error, slot.result
+        if error is not None:
+            raise error
+        return result
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._slots)

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autotune as _autotune
 from .. import runtime
 from .. import timeline as _timeline
+from ..loopback import dispatch as _lb
 from ..dynamic import (
     REQ_ALLGATHER,
     REQ_ALLREDUCE,
@@ -720,6 +721,22 @@ def _dtype_id(dt) -> int:
 _auto_counters: dict = {}
 
 
+def _auto_counter_table() -> dict:
+    """Auto-name counters for this thread's world: loopback rank threads
+    each advance their OWN counters (the per-process contract — a shared
+    table would let one rank's traffic desynchronize every rank's
+    negotiation names)."""
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    return ctx.auto_counters if ctx is not None else _auto_counters
+
+
+def _reset_auto_counters() -> None:
+    """World reset (engine_service.reset_service): names restart from
+    zero in this thread's world."""
+    _auto_counter_table().clear()
+
+
 def _auto_name(kind: str, pset: ProcessSet) -> str:
     """Deterministic per-(kind, set) auto names. Counters are keyed by the
     set so processes outside a subset (which never see its ops) don't fall
@@ -727,7 +744,7 @@ def _auto_name(kind: str, pset: ProcessSet) -> str:
     of later *global* ops across processes."""
     from .. import engine_service
     key = (kind, engine_service._set_key(pset))
-    counter = _auto_counters.setdefault(key, _itertools.count())
+    counter = _auto_counter_table().setdefault(key, _itertools.count())
     n = next(counter)
     if key[1] == "0":
         return f"{kind}.{n}"
@@ -750,18 +767,25 @@ def _negotiate_eager(kind: str, request_type: int, name: str | None,
     member processes (the reference's per-ProcessSet controller,
     ``process_set.h:26-84``), so non-members legally never submitting a
     subset op is not reported as a stall.
+
+    Returns ``(response, negotiated name)`` — ``(None, None)`` when no
+    service runs. The name keys the loopback execution rendezvous
+    (``loopback/dispatch.py``): it is the one token guaranteed unique
+    while in flight AND identical across every member.
     """
     from .. import engine_service
     svc = engine_service.get_service(pset)
     if svc is None:
-        return None
+        return None, None
+    neg_name = name or _auto_name(kind, pset)
     dt = jnp.dtype(dtype)
-    return svc.negotiate(name or _auto_name(kind, pset), request_type,
+    return svc.negotiate(neg_name, request_type,
                          dtype=_dtype_id(dt),
                          element_size=dt.itemsize, shape=tuple(shape),
                          root_rank=root_rank, splits=splits,
                          reduce_op=reduce_op, prescale=prescale,
-                         postscale=postscale, splits_crc=splits_crc)
+                         postscale=postscale,
+                         splits_crc=splits_crc), neg_name
 
 
 def _request_dict(name: str, request_type: int, shape, dtype,
@@ -793,14 +817,18 @@ def _group_requests(base: str, request_type: int, shapes_dtypes,
 
 def _negotiate_eager_group(kind: str, request_type: int, name: str | None,
                            shapes_dtypes, pset: ProcessSet,
-                           **meta) -> None:
-    """Batch variant for grouped ops: all members land in one cycle."""
+                           **meta) -> list | None:
+    """Batch variant for grouped ops: all members land in one cycle.
+    Returns the negotiated member names (``base.i``), or None when no
+    service runs — the first name keys the loopback rendezvous."""
     from .. import engine_service
     svc = engine_service.get_service(pset)
     if svc is None:
-        return
-    svc.negotiate_many(_group_requests(name or _auto_name(kind, pset),
-                                       request_type, shapes_dtypes, **meta))
+        return None
+    reqs = _group_requests(name or _auto_name(kind, pset),
+                           request_type, shapes_dtypes, **meta)
+    svc.negotiate_many(reqs)
+    return [r["name"] for r in reqs]
 
 
 # ---------------------------------------------------------------------------
@@ -862,6 +890,7 @@ def _plan_negotiation(kind: str, request_type: int, name: str | None,
             _dispatch.note_negotiation_skip()
         return resp
 
+    negotiate.neg_name = neg_name  # loopback rendezvous key (per plan)
     return negotiate
 
 
@@ -882,6 +911,7 @@ def _plan_group_negotiation(kind: str, request_type: int, name: str | None,
             _dispatch.note_negotiation_skip()
         return resps
 
+    negotiate.neg_name = reqs[0]["name"] if reqs else None
     return negotiate
 
 
@@ -974,10 +1004,13 @@ def _build_allreduce_plan(sig, pset: ProcessSet, axis, op: ReduceOp,
         # program variant and the chunk pipeline stay single-controller
         # optimizations: a joined rank cannot reconstruct them from
         # response metadata.
+        lb_key = negotiate.neg_name
+
         def execute(t):
             bundle, _ = _as_bundle(t, pset)
             return _execute_allreduce_bundle(bundle, pset, axis,
-                                             lowered_op, pre, post)
+                                             lowered_op, pre, post,
+                                             lb_key=lb_key)
         return _dispatch.DispatchPlan(name or "allreduce", "ALLREDUCE",
                                       nbytes, negotiate, execute)
     if (lowered_op == ReduceOp.SUM
@@ -1244,12 +1277,14 @@ def _build_grouped_allreduce_plan(tensors, sigs, pset: ProcessSet, axis,
         # one composition a joined process can rebuild from response
         # metadata alone. Split fuse/wire jits, donation, and the chunk
         # pipeline remain single-controller-only (ROADMAP alignment item).
+        lb_key = negotiate.neg_name
+
         def execute(ts):
             bundles = [_as_bundle(t, pset)[0] for t in ts]
             wire = [_wire_dtype_of(b, compression) for b in bundles]
             return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
                                             pre, post, count,
-                                            wire_dtypes=wire)
+                                            wire_dtypes=wire, lb_key=lb_key)
         return _dispatch.DispatchPlan(name or "grouped_allreduce",
                                       "GROUPED_ALLREDUCE", nbytes,
                                       negotiate, execute)
@@ -1302,6 +1337,21 @@ def _build_broadcast_plan(sig, pset: ProcessSet, axis, root_rank: int,
     per_shape = sig[1][1:] if bundled else sig[1]
     dtype = jnp.dtype(sig[2])
     root_pos = pset.ranks.index(root_rank)
+    negotiate = _plan_negotiation("broadcast", REQ_BROADCAST, name,
+                                  per_shape, dtype, pset,
+                                  root_rank=root_rank)
+    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
+    if negotiate is not None and _lb.active():
+        # Loopback plan variant (per-context cache: never serves a real
+        # multi-process world): rendezvous the rows, root's row wins.
+        lb_key = negotiate.neg_name
+
+        def execute(t):
+            bundle, _ = _as_bundle(t, pset)
+            return _execute_broadcast_bundle(bundle, pset, axis, root_pos,
+                                             lb_key=lb_key)
+        return _dispatch.DispatchPlan(name or "broadcast", "BROADCAST",
+                                      nbytes, negotiate, execute)
     fn = _eager_broadcast_fn(pset.mesh(), axis, root_pos, bundled)
     if bundled:
         def execute(t):
@@ -1309,10 +1359,6 @@ def _build_broadcast_plan(sig, pset: ProcessSet, axis, root_rank: int,
     else:
         def execute(t):
             return fn(jnp.asarray(t))
-    negotiate = _plan_negotiation("broadcast", REQ_BROADCAST, name,
-                                  per_shape, dtype, pset,
-                                  root_rank=root_rank)
-    nbytes = int(np.prod(per_shape) or 1) * dtype.itemsize
     return _dispatch.DispatchPlan(name or "broadcast", "BROADCAST", nbytes,
                                   negotiate, execute)
 
@@ -1327,6 +1373,26 @@ def _build_grouped_broadcast_plan(tensors, sigs, pset: ProcessSet, axis,
     bundled = any(s[0] == "b" for s in sigs)
     shapes = [s[1][1:] if s[0] == "b" else s[1] for s in sigs]
     src_dts = [jnp.dtype(s[2]) for s in sigs]
+    negotiate = _plan_group_negotiation(
+        "grouped_broadcast", REQ_BROADCAST, name,
+        [(shp, jnp.dtype(s[2])) for shp, s in zip(shapes, sigs)], pset,
+        root_rank=root_rank)
+    if negotiate is not None and _lb.active():
+        lb_key = negotiate.neg_name
+
+        def execute(ts):
+            bundles = [_as_bundle(t, pset)[0] for t in ts]
+            ch = _lb.channel(pset, lb_key)
+            if ch is None:  # world torn down mid-plan: plain bundles
+                fi, ms = _fuse_by_dtype(bundles, n)
+                f = _eager_grouped_broadcast_fn(pset.mesh(), axis,
+                                                root_pos, len(fi))
+                return _split_fused(f(*fi), ms, count)
+            return _lb_grouped_broadcast(ch, bundles, pset, axis,
+                                         root_pos, count)
+        return _dispatch.DispatchPlan(name or "grouped_broadcast",
+                                      "GROUPED_BROADCAST", None, negotiate,
+                                      execute)
     metas = _fusion_metas(shapes, src_dts, src_dts)
     donate = _sig_donate_mask(metas, sigs, bundled)
     smap = _grouped_broadcast_smap(pset.mesh(), axis, root_pos, len(metas),
@@ -1337,10 +1403,6 @@ def _build_grouped_broadcast_plan(tensors, sigs, pset: ProcessSet, axis,
 
     def execute(ts):
         return list(wire_fn(*fuse_fn(*canon(ts))))
-    negotiate = _plan_group_negotiation(
-        "grouped_broadcast", REQ_BROADCAST, name,
-        [(shp, jnp.dtype(s[2])) for shp, s in zip(shapes, sigs)], pset,
-        root_rank=root_rank)
     return _dispatch.DispatchPlan(name or "grouped_broadcast",
                                   "GROUPED_BROADCAST", None, negotiate,
                                   execute)
@@ -1447,19 +1509,34 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
     # jax build without the trace-state probe: generic bundle path
     lowered_op, post = handle_average(op, pset.size(), postscale_factor)
     bundle, _ = _as_bundle(tensor, pset)
-    _negotiate_eager("allreduce", REQ_ALLREDUCE, name, bundle.shape[1:],
-                     bundle.dtype, pset, reduce_op=int(lowered_op),
-                     prescale=float(prescale_factor), postscale=float(post))
+    _resp, neg_name = _negotiate_eager(
+        "allreduce", REQ_ALLREDUCE, name, bundle.shape[1:],
+        bundle.dtype, pset, reduce_op=int(lowered_op),
+        prescale=float(prescale_factor), postscale=float(post))
     _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "allreduce", "ALLREDUCE"):
         return _execute_allreduce_bundle(bundle, pset, axis, lowered_op,
-                                         float(prescale_factor), float(post))
+                                         float(prescale_factor), float(post),
+                                         lb_key=neg_name)
 
 
-def _execute_allreduce_bundle(bundle, pset, axis, lowered_op, pre, post):
+def _execute_allreduce_bundle(bundle, pset, axis, lowered_op, pre, post,
+                              lb_key=None):
     """Dispatch one eager allreduce program for a (n, ...) bundle — shared
     by the caller path and the joined-rank zero-contribution path, which
-    must produce the identical SPMD program."""
+    must produce the identical SPMD program.
+
+    ``lb_key`` (the negotiated tensor name) routes a loopback world's
+    execution through the rendezvous hub: each rank contributes its OWN
+    bundle row, and the completing rank runs this very function's body
+    over the reconstructed true bundle — so loopback numerics are the
+    single-controller program's, bit for bit."""
+    ch = _lb.channel(pset, lb_key)
+    if ch is not None:
+        return ch.compute(
+            bundle[ch.pos],
+            lambda rows: _execute_allreduce_bundle(
+                jnp.stack(rows), pset, axis, lowered_op, pre, post))
     if (lowered_op == ReduceOp.SUM
             and hierarchical.hierarchical_enabled_for(pset)):
         # HVD_HIERARCHICAL_ALLREDUCE: two-phase ICI/DCN schedule (the
@@ -1575,18 +1652,21 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,  # 
     n = pset.size()
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     wire_dts = [_wire_dtype_of(b, compression) for b in bundles]
-    _negotiate_eager_group("grouped_allreduce", REQ_ALLREDUCE, name,
-                           [(b.shape[1:], dt)
-                            for b, dt in zip(bundles, wire_dts)], pset,
-                           reduce_op=int(lowered_op),
-                           prescale=float(prescale_factor),
-                           postscale=float(post))
+    neg_names = _negotiate_eager_group(
+        "grouped_allreduce", REQ_ALLREDUCE, name,
+        [(b.shape[1:], dt)
+         for b, dt in zip(bundles, wire_dts)], pset,
+        reduce_op=int(lowered_op),
+        prescale=float(prescale_factor),
+        postscale=float(post))
     _autotune.record(sum(int(np.prod(b.shape[1:]) or 1) * dt.itemsize
                          for b, dt in zip(bundles, wire_dts)))
     with _timeline.op_range(name or "grouped_allreduce", "GROUPED_ALLREDUCE"):
         return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
                                         float(prescale_factor), float(post),
-                                        len(tensors), wire_dtypes=wire_dts)
+                                        len(tensors), wire_dtypes=wire_dts,
+                                        lb_key=neg_names[0] if neg_names
+                                        else None)
 
 
 def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
@@ -1624,9 +1704,20 @@ def _grouped_allreduce_traced_fused(tensors, axis, op, pre, post, groups,
 
 
 def _execute_grouped_bundles(bundles, pset, axis, lowered_op, pre, post,
-                             count, wire_dtypes=None):
+                             count, wire_dtypes=None, lb_key=None):
     """One fused eager grouped-allreduce program over (n, ...) bundles —
-    shared by the caller path and the joined-rank zero path."""
+    shared by the caller path and the joined-rank zero path. ``lb_key``:
+    see :func:`_execute_allreduce_bundle`."""
+    ch = _lb.channel(pset, lb_key)
+    if ch is not None:
+        rows = tuple(b[ch.pos] for b in bundles)
+        return ch.compute(
+            rows,
+            lambda allrows: _execute_grouped_bundles(
+                [jnp.stack([r[i] for r in allrows])
+                 for i in range(len(bundles))],
+                pset, axis, lowered_op, pre, post, count,
+                wire_dtypes=wire_dtypes))
     n = pset.size()
     fused_inputs, metas = _fuse_by_dtype(bundles, n, wire_dtypes=wire_dtypes)
     # No donation here: this generic path doubles as the HVD_CACHE_CAPACITY=0
@@ -1708,8 +1799,9 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,  # hvdlint: time
         crc = _i64_digest(local_d0s)
         if one_to_one:
             neg_shape = (local_d0s[my_pos],) + bundle.shape[2:]
-    resp = _negotiate_eager("allgather", REQ_ALLGATHER, name, neg_shape,
-                            bundle.dtype, pset, splits_crc=crc)
+    resp, neg_name = _negotiate_eager("allgather", REQ_ALLGATHER, name,
+                                      neg_shape, bundle.dtype, pset,
+                                      splits_crc=crc)
 
     # Resolve the per-rank row counts. The routing rule must be a pure
     # function of the engine response so active and joined processes build
@@ -1743,13 +1835,24 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,  # hvdlint: time
 
     _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "allgather", "ALLGATHER"):
-        if d0s is not None:
-            return _execute_ragged_allgather(bundle, d0s, maxd, pset, axis)
-        if bundle.ndim >= 2 and bundle.shape[1] == 0:
+        if d0s is None and bundle.ndim >= 2 and bundle.shape[1] == 0:
             # uniform zero-row gather: no data moves and XLA forbids a
             # zero-size gather dim — the result is empty on every rank
-            # (joined peers skip the program identically)
+            # (joined peers — and loopback ranks — skip identically:
+            # the engine negotiated every dim 0, so the decision is
+            # rank-consistent)
             return jnp.zeros((0,) + bundle.shape[2:], bundle.dtype)
+        if d0s is not None and max(d0s) == 0 and _lb.active():
+            # loopback: an all-zero ragged gather skips the exchange
+            # BEFORE a channel is created (channel creation advances the
+            # per-name occurrence counter, and the joined-rank zero path
+            # skips on the same predicate — the counters must not drift)
+            return jnp.zeros((0,) + tuple(bundle.shape[2:]), bundle.dtype)
+        ch = _lb.channel(pset, neg_name)
+        if ch is not None:
+            return _loopback_allgather(ch, bundle, d0s)
+        if d0s is not None:
+            return _execute_ragged_allgather(bundle, d0s, maxd, pset, axis)
         if hierarchical.hierarchical_allgather_enabled_for(pset):
             # HVD_HIERARCHICAL_ALLGATHER: ICI-then-DCN two-phase gather.
             hmesh = hierarchical.hierarchical_mesh()
@@ -1762,6 +1865,60 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,  # hvdlint: time
             bundle = bundle[:, None]
             return _eager_allgather_fn(pset.mesh(), axis)(bundle).reshape(-1)
         return _eager_allgather_fn(pset.mesh(), axis)(bundle)
+
+
+def _lb_gather_parts(rest, dtype):
+    """THE loopback allgather combiner, shared by the active path and the
+    joined-rank zero contribution — whichever rank completes the slot
+    runs it, so both must supply the identical closure."""
+    rest = tuple(rest)
+
+    def gather(parts):
+        parts = [p for p in parts if p.shape[0] > 0]
+        if not parts:
+            return jnp.zeros((0,) + rest, dtype)
+        return jnp.concatenate(parts, axis=0)
+
+    return gather
+
+
+def _lb_stack_parts(parts):
+    """Scalar-allgather combiner (one scalar per rank -> (n,) vector).
+    Module-level so the active path and the joined-rank zero
+    contribution supply the literally identical function."""
+    return jnp.stack(parts)
+
+
+def _lb_grouped_broadcast(ch, bundles, pset, axis, root_pos, count):
+    """THE loopback grouped-broadcast execution, shared by the plan,
+    immediate, and queued paths — one combiner, so leader-dependent
+    results cannot drift between the three call sites."""
+    n = pset.size()
+
+    def compute(allrows):
+        bs = [jnp.stack([r[i] for r in allrows])
+              for i in range(len(bundles))]
+        fi, ms = _fuse_by_dtype(bs, n)
+        f = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
+                                        len(fi))
+        return _split_fused(f(*fi), ms, count)
+
+    return ch.compute(tuple(b[ch.pos] for b in bundles), compute)
+
+
+def _loopback_allgather(ch, bundle, d0s):
+    """Loopback allgather execution: each rank contributes its valid rows
+    (ragged: trimmed to its negotiated first dim — a joined rank's zero
+    rows included), and the completing rank concatenates in set order.
+    No arithmetic happens, so the result is exact."""
+    if bundle.ndim == 1:  # scalar per rank -> (n,) vector; a joined
+        # peer contributes a zero scalar, like the real (n, 1) program
+        return ch.compute(bundle[ch.pos], _lb_stack_parts)
+    rows = bundle[ch.pos]
+    if d0s is not None:
+        rows = rows[:d0s[ch.pos]]
+    return ch.compute(rows, _lb_gather_parts(bundle.shape[2:],
+                                             bundle.dtype))
 
 
 def _execute_ragged_allgather(bundle, d0s, maxd, pset: ProcessSet, axis):
@@ -1820,11 +1977,25 @@ def broadcast(tensor, root_rank: int, *, process_set: ProcessSet | None = None,
             "so the op can lower to an XLA collective.")
     bundle, _ = _as_bundle(tensor, pset)
     root_pos = pset.ranks.index(root_rank)
-    _negotiate_eager("broadcast", REQ_BROADCAST, name, bundle.shape[1:],
-                     bundle.dtype, pset, root_rank=root_rank)
+    _resp, neg_name = _negotiate_eager("broadcast", REQ_BROADCAST, name,
+                                       bundle.shape[1:], bundle.dtype, pset,
+                                       root_rank=root_rank)
     _autotune.record(bundle.nbytes // max(bundle.shape[0], 1))
     with _timeline.op_range(name or "broadcast", "BROADCAST"):
-        return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
+        return _execute_broadcast_bundle(bundle, pset, axis, root_pos,
+                                         lb_key=neg_name)
+
+
+def _execute_broadcast_bundle(bundle, pset, axis, root_pos, lb_key=None):
+    """One eager broadcast program for a (n, ...) bundle; under loopback,
+    rows rendezvous first (see :func:`_execute_allreduce_bundle`)."""
+    ch = _lb.channel(pset, lb_key)
+    if ch is not None:
+        return ch.compute(
+            bundle[ch.pos],
+            lambda rows: _execute_broadcast_bundle(
+                jnp.stack(rows), pset, axis, root_pos))
+    return _eager_broadcast_fn(pset.mesh(), axis, root_pos)(bundle)
 
 
 # timer-boundary: see grouped_allreduce — timer flushes are single-
@@ -1869,10 +2040,15 @@ def grouped_broadcast(tensors: Sequence, root_rank: int, *,  # hvdlint: timer-bo
     root_pos = pset.ranks.index(root_rank)
     bundles = [_as_bundle(t, pset)[0] for t in tensors]
     fused_inputs, metas = _fuse_by_dtype(bundles, n)
-    _negotiate_eager_group("grouped_broadcast", REQ_BROADCAST, name,
-                           [(b.shape[1:], b.dtype) for b in bundles], pset,
-                           root_rank=root_rank)
+    neg_names = _negotiate_eager_group(
+        "grouped_broadcast", REQ_BROADCAST, name,
+        [(b.shape[1:], b.dtype) for b in bundles], pset,
+        root_rank=root_rank)
     with _timeline.op_range(name or "grouped_broadcast", "GROUPED_BROADCAST"):
+        ch = _lb.channel(pset, neg_names[0] if neg_names else None)
+        if ch is not None:
+            return _lb_grouped_broadcast(ch, bundles, pset, axis,
+                                         root_pos, len(tensors))
         fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
                                          len(fused_inputs))
         fused_outputs = fn(*fused_inputs)
@@ -1917,10 +2093,17 @@ def alltoall(tensor, splits=None, *, process_set: ProcessSet | None = None,
     if bundle.shape[1] % n != 0:
         raise ValueError(f"alltoall dim0 ({bundle.shape[1]}) must be divisible "
                          f"by process set size ({n})")
-    _negotiate_eager("alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
-                     bundle.dtype, pset)
+    _resp, neg_name = _negotiate_eager("alltoall", REQ_ALLTOALL, name,
+                                       bundle.shape[1:], bundle.dtype, pset)
     with _timeline.op_range(name or "alltoall", "ALLTOALL"):
-        out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
+        ch = _lb.channel(pset, neg_name)
+        if ch is not None:
+            out = ch.compute(
+                bundle[ch.pos],
+                lambda rows: _eager_alltoall_fn(pset.mesh(), axis)(
+                    jnp.stack(rows)))
+        else:
+            out = _eager_alltoall_fn(pset.mesh(), axis)(bundle)
     return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
 
 
@@ -1973,10 +2156,10 @@ def _alltoall_uneven(tensor, splits, pset: ProcessSet, axis,
     crc = _i64_digest(smat)
     member_procs, one_to_one, my_pos = _member_process_view(pset)
     my_row = smat[my_pos] if one_to_one else ()
-    resp = _negotiate_eager("alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
-                            bundle.dtype, pset,
-                            splits=tuple(int(s) for s in my_row),
-                            splits_crc=crc)
+    resp, neg_name = _negotiate_eager(
+        "alltoall", REQ_ALLTOALL, name, bundle.shape[1:],
+        bundle.dtype, pset, splits=tuple(int(s) for s in my_row),
+        splits_crc=crc)
     recv_splits = smat.T.copy()  # recv_splits[r][j] = rows rank j sends rank r
     if resp is not None and resp.recv_splits and one_to_one:
         mine = list(recv_splits[my_pos])
@@ -1994,8 +2177,18 @@ def _alltoall_uneven(tensor, splits, pset: ProcessSet, axis,
     mask = k_range[None, None, :] < smat[:, :, None]
 
     with _timeline.op_range(name or "alltoall", "ALLTOALL"):
-        out = _eager_uneven_alltoall_fn(pset.mesh(), axis)(
-            bundle, jnp.asarray(idx, jnp.int32), jnp.asarray(mask))
+        ch = _lb.channel(pset, neg_name)
+        if ch is not None:
+            # idx/mask derive from the cross-validated splits matrix, so
+            # the leader's copies equal every rank's
+            out = ch.compute(
+                bundle[ch.pos],
+                lambda rows: _eager_uneven_alltoall_fn(pset.mesh(), axis)(
+                    jnp.stack(rows), jnp.asarray(idx, jnp.int32),
+                    jnp.asarray(mask)))
+        else:
+            out = _eager_uneven_alltoall_fn(pset.mesh(), axis)(
+                bundle, jnp.asarray(idx, jnp.int32), jnp.asarray(mask))
     # out: (n*n, max_chunk, ...); rows [r*n:(r+1)*n] = rank r's received
     # padded chunks, one per source rank
     out = out.reshape((n, n, max_chunk) + bundle.shape[2:])
@@ -2029,11 +2222,20 @@ def reducescatter(tensor, *, op: ReduceOp = ReduceOp.SUM,
     if bundle.shape[1] % n != 0:
         raise ValueError(f"reducescatter dim0 ({bundle.shape[1]}) must be "
                          f"divisible by process set size ({n})")
-    _negotiate_eager("reducescatter", REQ_REDUCESCATTER, name,
-                     bundle.shape[1:], bundle.dtype, pset)
+    _resp, neg_name = _negotiate_eager("reducescatter", REQ_REDUCESCATTER,
+                                       name, bundle.shape[1:], bundle.dtype,
+                                       pset)
     with _timeline.op_range(name or "reducescatter", "REDUCESCATTER"):
-        out = _eager_reducescatter_fn(pset.mesh(), axis, lowered_op,
-                                      float(post))(bundle)
+        ch = _lb.channel(pset, neg_name)
+        if ch is not None:
+            out = ch.compute(
+                bundle[ch.pos],
+                lambda rows: _eager_reducescatter_fn(
+                    pset.mesh(), axis, lowered_op,
+                    float(post))(jnp.stack(rows)))
+        else:
+            out = _eager_reducescatter_fn(pset.mesh(), axis, lowered_op,
+                                          float(post))(bundle)
     return PerRank(out.reshape((n, out.shape[0] // n) + out.shape[1:]))
 
 
@@ -2069,8 +2271,11 @@ def _execute_joined_zeros(responses) -> None:
     pset = _resolve(None)
     axis = _resolve_axis(None)
     n = pset.size()
-    # ("barrier",) | ("allgather", dtype, rest, d0s) |
-    # (dtype, shape, gid, op, pre, post)
+    # ("barrier",) | ("allgather", dtype, rest, d0s, name) |
+    # (dtype, shape, gid, op, pre, post, name) — the name is the
+    # negotiated tensor name, which keys the loopback rendezvous so a
+    # joined rank's zero contribution pairs with the active ranks'
+    # executions (loopback/dispatch.py).
     items = []
     for resp in responses:
         if resp.type == REQ_BARRIER:
@@ -2088,7 +2293,9 @@ def _execute_joined_zeros(responses) -> None:
             # from zero-row tensor gathers and carries the trailing dims.
             first_shape = tuple(resp.shapes[0]) if resp.shapes else ()
             items.append(("allgather", jnp.dtype(dtype_name), first_shape,
-                          tuple(int(s) for s in resp.recv_splits)))
+                          tuple(int(s) for s in resp.recv_splits),
+                          resp.tensor_names[0] if resp.tensor_names
+                          else None))
             continue
         if resp.type != REQ_ALLREDUCE:
             raise RuntimeError(
@@ -2101,10 +2308,11 @@ def _execute_joined_zeros(responses) -> None:
             raise RuntimeError(
                 f"hvd.join(): cannot reconstruct dtype id {resp.dtype} for "
                 f"zero contribution to {resp.tensor_names}")
-        for shape, gid in zip(resp.shapes, resp.group_ids):
+        for tname, shape, gid in zip(resp.tensor_names, resp.shapes,
+                                     resp.group_ids):
             items.append((jnp.dtype(dtype_name), tuple(shape), gid,
                           ReduceOp(resp.reduce_op), float(resp.prescale),
-                          float(resp.postscale)))
+                          float(resp.postscale), tname))
     def _tensor_bytes(dt, shape):
         return int(np.prod(shape) or 1) * jnp.dtype(dt).itemsize
 
@@ -2117,7 +2325,7 @@ def _execute_joined_zeros(responses) -> None:
             i += 1
             continue
         if items[i][0] == "allgather":
-            _, dt, first_shape, proc_d0s = items[i]
+            _, dt, first_shape, proc_d0s, tname = items[i]
             rest = first_shape[1:] if first_shape else ()
             # Expand per-process counts to per-rank rows and apply the
             # SAME routing rule as the active path (allgather() above):
@@ -2129,6 +2337,26 @@ def _execute_joined_zeros(responses) -> None:
                    for r in pset.ranks]
             _autotune.record(int(np.prod(rest) or 1) * dt.itemsize
                              * max(max(d0s), 1))
+            scalar = len(first_shape) == 0
+            if scalar or max(d0s) > 0:
+                ch = _lb.channel(pset, tname)
+                if ch is not None:
+                    # loopback: a joined rank contributes ZERO ROWS (a
+                    # zero SCALAR for scalar gathers — the active path's
+                    # ndim==1 branch stacks one value per rank, like the
+                    # real (n, 1) program) and discards the result —
+                    # participation parity with the active branch, which
+                    # skips only the all-dims-zero non-scalar gather.
+                    # The combiner must be the SAME closure the active
+                    # side supplies: whichever rank completes the slot
+                    # runs it.
+                    if scalar:
+                        ch.compute(jnp.zeros((), dt), _lb_stack_parts)
+                    else:
+                        ch.compute(jnp.zeros((0,) + tuple(rest), dt),
+                                   _lb_gather_parts(rest, dt))
+                    i += 1
+                    continue
             if len(set(d0s)) == 1:
                 # uniform (possibly zero-row) — mirror the active path's
                 # uniform branch exactly, hierarchical knob included
@@ -2153,14 +2381,15 @@ def _execute_joined_zeros(responses) -> None:
             jax.block_until_ready(out)
             i += 1
             continue
-        dt, shape, gid, op, pre, post = items[i]
+        dt, shape, gid, op, pre, post, tname = items[i]
         if gid < 0:
             # mirror the caller path's autotune accounting so sample
             # boundaries (and the synced tuning decisions that ride them)
             # stay aligned across joined and active processes
             _autotune.record(_tensor_bytes(dt, shape))
             out = _execute_allreduce_bundle(
-                jnp.zeros((n,) + shape, dt), pset, axis, op, pre, post)
+                jnp.zeros((n,) + shape, dt), pset, axis, op, pre, post,
+                lb_key=tname)
             jax.block_until_ready(out)
             i += 1
         else:
@@ -2170,12 +2399,12 @@ def _execute_joined_zeros(responses) -> None:
                 group.append(items[i])
                 i += 1
             _autotune.record(sum(_tensor_bytes(d, shp)
-                                 for d, shp, _, _, _, _ in group))
+                                 for d, shp, *_rest in group))
             bundles = [jnp.zeros((n,) + shp, d)
-                       for d, shp, _, _, _, _ in group]
+                       for d, shp, *_rest in group]
             outs = _execute_grouped_bundles(
                 bundles, pset, axis, group[0][3], group[0][4], group[0][5],
-                len(bundles))
+                len(bundles), lb_key=group[0][6])
             jax.block_until_ready(outs)
 
 
@@ -2481,11 +2710,11 @@ def _run_queued_allreduce(tensors, pset: ProcessSet, axis, op: ReduceOp,  # hvdl
             if wire_dts[0] != src:
                 b = b.astype(wire_dts[0])
             out = _execute_allreduce_bundle(b, pset, axis, lowered_op,
-                                            pre, post)
+                                            pre, post, lb_key=label)
             return [out.astype(src) if wire_dts[0] != src else out]
         return _execute_grouped_bundles(bundles, pset, axis, lowered_op,
                                         pre, post, len(tensors),
-                                        wire_dtypes=wire_dts)
+                                        wire_dtypes=wire_dts, lb_key=label)
 
 
 def _run_queued_broadcast(tensors, pset: ProcessSet, axis, root_rank: int,  # hvdlint: timer-boundary
@@ -2499,8 +2728,12 @@ def _run_queued_broadcast(tensors, pset: ProcessSet, axis, root_rank: int,  # hv
     with _timeline.op_range(label, "BROADCAST" if len(tensors) == 1
                             else "GROUPED_BROADCAST"):
         if len(bundles) == 1:
-            return [_eager_broadcast_fn(pset.mesh(), axis,
-                                        root_pos)(bundles[0])]
+            return [_execute_broadcast_bundle(bundles[0], pset, axis,
+                                              root_pos, lb_key=label)]
+        ch = _lb.channel(pset, label)
+        if ch is not None:
+            return _lb_grouped_broadcast(ch, bundles, pset, axis,
+                                         root_pos, len(tensors))
         fused_inputs, metas = _fuse_by_dtype(bundles, n)
         fn = _eager_grouped_broadcast_fn(pset.mesh(), axis, root_pos,
                                          len(fused_inputs))
@@ -2521,6 +2754,16 @@ def broadcast_object(obj, root_rank: int = 0, *, name: str | None = None):
     del name
     if runtime.process_count() <= 1:
         return obj
+    ch = _lb.object_channel()
+    if ch is not None:
+        # Loopback worlds exchange through the hub: jax's multihost
+        # utilities need a real multi-process backend. Only the root's
+        # payload travels.
+        root_process = runtime.process_of_rank(root_rank)
+        mine = pickle.dumps(obj) if runtime.process_rank() == root_process \
+            else b""
+        payloads = ch.gather(mine)
+        return pickle.loads(payloads[root_process])
     from jax.experimental import multihost_utils
     root_process = runtime.devices()[root_rank].process_index
     is_source = runtime.process_rank() == root_process
@@ -2540,6 +2783,9 @@ def allgather_object(obj, *, name: str | None = None) -> list:
     del name
     if runtime.process_count() <= 1:
         return [obj]
+    ch = _lb.object_channel()
+    if ch is not None:
+        return [pickle.loads(b) for b in ch.gather(pickle.dumps(obj))]
     return [pickle.loads(b) for b in _gather_bytes(pickle.dumps(obj))]
 
 

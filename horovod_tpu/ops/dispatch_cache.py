@@ -93,6 +93,21 @@ UNPLANNABLE = object()
 _lock = _inv.make_lock("dispatch_cache.lock")
 _plans: "OrderedDict[tuple, DispatchPlan]" = OrderedDict()
 _epoch: tuple | None = None
+
+
+def _ctx_store():
+    """Loopback rank threads get their own plan map: plan keys repeat
+    across ranks (same op/name/shape/pset id) but the cached ``negotiate``
+    closures pin each rank's OWN service and the execute closures pin its
+    exchange identity — one rank's plan must never serve another.
+    Counters stay process-wide (shared metrics)."""
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    if ctx is None:
+        return None
+    if ctx.plans is None:
+        ctx.plans = OrderedDict()
+    return ctx
 _hits = 0
 _misses = 0
 _invalidations = 0
@@ -153,11 +168,29 @@ def _current_epoch() -> tuple:
 
 
 def _flush_locked(count_invalidation: bool) -> None:
+    _flush_store_locked(_plans, count_invalidation)
+
+
+def _flush_store_locked(plans, count_invalidation: bool) -> None:
     global _invalidations
     _inv.assert_holding(_lock, "dispatch_cache plan-map flush")
     if count_invalidation:
-        _invalidations += len(_plans)
-    _plans.clear()
+        _invalidations += len(plans)
+    plans.clear()
+
+
+def _sync_epoch_locked(ctx, plans, epoch: tuple) -> None:
+    """Epoch-drift flush for the resolved store (shared by lookup and
+    store): a changed runtime generation / knob-override epoch drops
+    every plan before the map is read or written."""
+    global _epoch
+    prior = ctx.plan_epoch if ctx is not None else _epoch
+    if prior != epoch:
+        _flush_store_locked(plans, count_invalidation=prior is not None)
+        if ctx is not None:
+            ctx.plan_epoch = epoch
+        else:
+            _epoch = epoch
 
 
 def lookup(key: tuple, source: str | None = None,
@@ -176,16 +209,16 @@ def lookup(key: tuple, source: str | None = None,
         return None
     epoch = _current_epoch()
     src = source or current_source()
+    ctx = _ctx_store()
+    plans = ctx.plans if ctx is not None else _plans
     with _lock:
-        if _epoch != epoch:
-            _flush_locked(count_invalidation=_epoch is not None)
-            _epoch = epoch
-        plan = _plans.get(key)
+        _sync_epoch_locked(ctx, plans, epoch)
+        plan = plans.get(key)
         if plan is None:
             if record_stats:
                 _misses += 1
             return None
-        _plans.move_to_end(key)
+        plans.move_to_end(key)
         if plan is UNPLANNABLE:
             return plan  # negative decision: neither a hit nor a miss
         if record_stats:
@@ -216,18 +249,18 @@ def store(key: tuple, plan: DispatchPlan) -> None:
     if cap <= 0:
         return
     epoch = _current_epoch()
+    ctx = _ctx_store()
+    plans = ctx.plans if ctx is not None else _plans
     with _lock:
         if plan is not UNPLANNABLE and plan.variant == "chunked":
             _chunked_builds += 1
         if plan is not UNPLANNABLE and plan.variant == "step":
             _step_builds += 1
-        if _epoch != epoch:
-            _flush_locked(count_invalidation=_epoch is not None)
-            _epoch = epoch
-        _plans[key] = plan
-        _plans.move_to_end(key)
-        while len(_plans) > cap:
-            _plans.popitem(last=False)
+        _sync_epoch_locked(ctx, plans, epoch)
+        plans[key] = plan
+        plans.move_to_end(key)
+        while len(plans) > cap:
+            plans.popitem(last=False)
             _evictions += 1
     if plan is not UNPLANNABLE:
         _timeline.record_dispatch(plan.label, hit=False)
@@ -235,11 +268,14 @@ def store(key: tuple, plan: DispatchPlan) -> None:
 
 def invalidate(reason: str | None = None) -> int:
     """Flush every cached plan (process-set removal, service reset,
-    shutdown). Returns the number of plans dropped."""
+    shutdown) in this thread's world — a loopback rank invalidates its
+    own store. Returns the number of plans dropped."""
     del reason
+    ctx = _ctx_store()
+    plans = ctx.plans if ctx is not None else _plans
     with _lock:
-        n = len(_plans)
-        _flush_locked(count_invalidation=True)
+        n = len(plans)
+        _flush_store_locked(plans, count_invalidation=True)
     return n
 
 

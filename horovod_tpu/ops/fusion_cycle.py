@@ -684,18 +684,35 @@ class FusionScheduler:
         dispatch rounds (so the coalesce ratio means the same thing in
         single-controller and multi-process jobs) and ``wire_programs``
         counts the actual program batches issued."""
-        for unit in units:
-            outs = run_unit(unit)
-            i = 0
-            for e in unit:
-                e.results = list(outs[i:i + e.count])
-                i += e.count
-                e.tensors = ()  # release inputs: handles keep results only
-                e.run = None
+        settled = []
+        try:
+            for unit in units:
+                outs = run_unit(unit)
+                i = 0
+                for e in unit:
+                    e.results = list(outs[i:i + e.count])
+                    i += e.count
+                    e.tensors = ()  # release inputs: handles keep results
+                    e.run = None
+                    settled.append(e)
+        except BaseException:
+            # a later unit failing must not poison earlier units whose
+            # wire programs already ran (peers counted them as done):
+            # settle the completed entries with their results before the
+            # error reaches _fail_entries (which skips done entries)
+            for e in settled:
                 e.event.set()
+            raise
         with self._mu:
             self._stats["dispatches"] += 1
             self._stats["wire_programs"] += len(units)
+        # Events last, results and stats first: the moment ANY waiter
+        # wakes, the whole flush's accounting is final (a synchronize on
+        # one entry of a batch used to race the remaining event sets and
+        # the stats bump — observable as a peer entry briefly "not done"
+        # after its batch already executed).
+        for e in settled:
+            e.event.set()
 
     def _run_fused_unit(self, spec: _QueueSpec, unit: list[_Entry]) -> list:
         from . import collectives as _coll
@@ -1000,11 +1017,31 @@ _scheduler_lock = threading.Lock()
 
 
 def scheduler() -> FusionScheduler:
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    if ctx is not None:
+        # One scheduler per loopback rank: each rank's flush composition
+        # and pipelined executor are its own, like one per process.
+        if ctx.scheduler is None:
+            with _scheduler_lock:
+                if ctx.scheduler is None:
+                    ctx.scheduler = FusionScheduler()
+        return ctx.scheduler
     global _scheduler
     if _scheduler is None:
         with _scheduler_lock:
             if _scheduler is None:
                 _scheduler = FusionScheduler()
+    return _scheduler
+
+
+def _current_scheduler() -> FusionScheduler | None:
+    """The already-created scheduler for this thread's world (loopback
+    rank or process-wide), without creating one."""
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    if ctx is not None:
+        return ctx.scheduler
     return _scheduler
 
 
@@ -1218,7 +1255,7 @@ def queue_opaque(kind: str, run, *, process_set=None, nbytes: int = 0,
 # -- module-level conveniences (mirror dispatch_cache's surface) ------------
 
 def flush_all(trigger: str = "barrier") -> None:
-    sched = _scheduler
+    sched = _current_scheduler()
     if sched is not None:
         sched.flush_all(trigger)
 
@@ -1237,7 +1274,7 @@ def fusion_flush() -> None:
 def drain() -> None:
     """Clean-shutdown hook (``hvd.shutdown()``): execute everything still
     queued so no submitted collective is silently dropped."""
-    sched = _scheduler
+    sched = _current_scheduler()
     if sched is not None:
         sched.drain()
         sched.stop()
@@ -1245,7 +1282,7 @@ def drain() -> None:
 
 def abort(reason: str) -> int:
     """Service-reset hook (elastic teardown): fail pending entries."""
-    sched = _scheduler
+    sched = _current_scheduler()
     if sched is not None:
         return sched.abort(reason)
     return 0
@@ -1260,9 +1297,13 @@ def reset() -> None:
     """Tests / teardown: drop queues (aborting pending entries), stop the
     timer, and zero the counters."""
     global _scheduler
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
     with _scheduler_lock:
-        sched = _scheduler
-        _scheduler = None
+        if ctx is not None:
+            sched, ctx.scheduler = ctx.scheduler, None
+        else:
+            sched, _scheduler = _scheduler, None
     if sched is not None:
         sched.abort("fusion scheduler reset")
         sched.stop()

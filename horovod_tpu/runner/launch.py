@@ -85,6 +85,12 @@ def parse_args(argv=None):
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--env", action="append", default=[],
                         metavar="NAME=VALUE", help="extra env for workers")
+    parser.add_argument("--loopback", action="store_true",
+                        help="run all ranks as threads in ONE interpreter "
+                             "over the in-process loopback engine "
+                             "(hvd.loopback; docs/loopback.md) — the "
+                             "world>1 stack without cross-process XLA, "
+                             "so jax<0.5 CPU backends work")
     parser.add_argument("--launcher", choices=("auto", "local", "lsf"),
                         default="auto",
                         help="host-source escape hatch: 'auto' derives "
@@ -458,6 +464,9 @@ def run_commandline(argv=None) -> int:
             print(f"hvdrun: elastic launch unavailable ({e})", file=sys.stderr)
             return 2
         return run_elastic(args, command)
+    if args.loopback:
+        from ..loopback.engine import run_command as run_loopback
+        return run_loopback(args, command)
     return run_static(args, command)
 
 

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .loopback import context as _lbctx
 from .utils import envs
 from .utils import logging as hvd_logging
 
@@ -46,6 +47,11 @@ class _RuntimeState:
     process_count: int
     local_ranks: list  # global ranks owned by this process
     process_set_table: Any  # ProcessSetTable (import cycle avoided)
+    # Loopback worlds: rank -> owning (virtual) process. In a real world
+    # the mapping comes from each device's process_index; loopback ranks
+    # share one interpreter whose fake CPU devices all report process 0,
+    # so the world records the virtual mapping explicitly.
+    rank_process_map: list | None = None
 
 
 _state: _RuntimeState | None = None
@@ -85,6 +91,23 @@ def init(
       devices: explicit device list (testing hook).
       axis_name: mesh axis name used by every collective.
     """
+    ctx = _lbctx.current()
+    if ctx is not None:
+        _loopback_init(ctx, axis_name=axis_name, process_sets=process_sets)
+        return
+    if envs.get_bool(envs.LOOPBACK):
+        # Satellite fix (ISSUE 10): a half-configured loopback env — the
+        # HVD_LOOPBACK marker without a rank context (e.g. exported
+        # manually, or a loopback worker env leaked into a plain
+        # process) — must fail HERE with a clear message. Proceeding
+        # would treat the leaked HVD_KV_*/HVD_NUM_PROCESSES contract as
+        # a real multi-process launch and hang on KV connect.
+        raise RuntimeError(
+            "HVD_LOOPBACK=1 is set but this thread has no loopback rank "
+            "context. Loopback worlds are created with "
+            "hvd.loopback.world(n) (or `hvdrun --loopback`); do not "
+            "export HVD_LOOPBACK/HVD_KV_* by hand. Unset HVD_LOOPBACK "
+            "to run as a normal process.")
     global _state, _generation
     with _lock:
         if _state is not None:
@@ -134,6 +157,54 @@ def init(
     # operations.cc:811-864): every process must tick cycles even before
     # its first collective, or peers' exchanges block and stalls go
     # undetected.
+    from . import engine_service as _engine_service
+    _engine_service.get_service()
+
+
+def _loopback_init(ctx, *, axis_name: str = AXIS_NAME,
+                   process_sets=None) -> None:
+    """``init()`` on a loopback rank thread: build this rank's world view
+    from its env overlay — no ``jax.distributed``, no cross-process XLA
+    program, ever. The negotiation service (real KV wire format) starts
+    immediately, exactly like the multi-process init path."""
+    if ctx.runtime_state is not None:
+        hvd_logging.debug("loopback init() called twice; ignoring")
+        return
+    missing = [v for v in (envs.NUM_PROCESSES, envs.PROCESS_ID,
+                           envs.KV_ADDR, envs.KV_PORT)
+               if envs.get(v) is None]
+    if missing:
+        raise RuntimeError(
+            "loopback rank context is half-configured: missing "
+            f"HVD_{'/HVD_'.join(missing)}. Loopback worlds seed the full "
+            "launcher contract via hvd.loopback.world(n); refusing to "
+            "init rather than hang on KV connect (docs/loopback.md).")
+    size = int(envs.require(envs.NUM_PROCESSES))
+    rank = int(envs.require(envs.PROCESS_ID))
+    if not 0 <= rank < size:
+        raise RuntimeError(
+            f"loopback rank {rank} out of range for world size {size}")
+    from .loopback.engine import _check_devices
+    _check_devices(size)  # shared check + XLA_FLAGS hint
+    devs = _rank_ordered_devices(None)[:size]
+    mesh = Mesh(np.array(devs), (axis_name,))
+    from .process_sets import ProcessSetTable
+    table = ProcessSetTable()
+    ctx.generation += 1
+    ctx.runtime_state = _RuntimeState(
+        devices=devs, mesh=mesh, axis_name=axis_name,
+        process_index=rank, process_count=size, local_ranks=[rank],
+        process_set_table=table, rank_process_map=list(range(size)))
+    table.initialize_global(size)
+    dynamic = (process_sets == "dynamic"
+               or envs.get_bool(envs.DYNAMIC_PROCESS_SETS))
+    table.dynamic_enabled = dynamic
+    if process_sets and process_sets != "dynamic":
+        for ranks in process_sets:
+            table.add(list(ranks), force=True)
+    hvd_logging.info(
+        "loopback initialized: rank %d of %d (world %s)", rank, size,
+        envs.get(envs.COORDINATOR_ADDR, "?"))
     from . import engine_service as _engine_service
     _engine_service.get_service()
 
@@ -358,6 +429,10 @@ def shutdown() -> None:
     ``operations.cc:926-942``). Also stops the negotiation service — it is
     bound to this world's size/rank/KV prefix and must be rebuilt by the
     next init()."""
+    ctx = _lbctx.current()
+    if ctx is not None:
+        _loopback_shutdown(ctx)
+        return
     global _state, _bootstrap_kv_server, _bootstrap_seeded_env
     from . import autotune as _autotune
     from . import engine_service as _engine_service
@@ -392,20 +467,60 @@ def shutdown() -> None:
         _state = None
 
 
+def _loopback_shutdown(ctx) -> None:
+    """``shutdown()`` on a loopback rank thread: drain this rank's
+    queued async work, stop its negotiation services, drop its dispatch
+    plans — the per-rank mirror of the process-wide teardown. Shared
+    process state (autotune, timeline, the OTHER ranks' worlds) is
+    untouched."""
+    if ctx.runtime_state is None:
+        return
+    from . import engine_service as _engine_service
+    from .ops import dispatch_cache as _dispatch_cache
+    from .ops import fusion_cycle as _fusion_cycle
+    try:
+        _fusion_cycle.drain()
+    except Exception:
+        hvd_logging.exception(
+            "loopback fusion-cycle drain failed at shutdown")
+    _engine_service.reset_service()
+    _dispatch_cache.invalidate("loopback runtime shutdown")
+    sched, ctx.scheduler = ctx.scheduler, None
+    if sched is not None:
+        sched.stop()
+    # NOTE: ctx.notification_manager deliberately survives — an elastic
+    # re-init calls this mid-run and the manager's listeners must carry
+    # into the next round (real elastic parity); the worker wrapper and
+    # _abrupt_stop shut it down when the rank truly ends.
+    ctx.runtime_state = None
+
+
+def _current_state() -> _RuntimeState | None:
+    ctx = _lbctx.current()
+    if ctx is not None:
+        return ctx.runtime_state
+    return _state
+
+
 def is_initialized() -> bool:
-    return _state is not None
+    return _current_state() is not None
 
 
 def generation() -> int:
-    """Monotonic init() counter (see ProcessSet.mesh cache)."""
+    """Monotonic init() counter (see ProcessSet.mesh cache). Loopback
+    rank threads count their own context's init()s."""
+    ctx = _lbctx.current()
+    if ctx is not None:
+        return ctx.generation
     return _generation
 
 
 def _get() -> _RuntimeState:
-    if _state is None:
+    st = _current_state()
+    if st is None:
         raise NotInitializedError(
             "horovod_tpu has not been initialized; call hvd.init() first.")
-    return _state
+    return st
 
 
 # --- rank/size queries (reference C API: operations.cc:944-1030) ----------
@@ -459,8 +574,12 @@ def is_homogeneous() -> bool:
     (reference ``horovod_is_homogeneous``, ``operations.cc:1013-1017``)."""
     st = _get()
     counts = {}
-    for d in st.devices:
-        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    if st.rank_process_map is not None:
+        for p in st.rank_process_map:
+            counts[p] = counts.get(p, 0) + 1
+    else:
+        for d in st.devices:
+            counts[d.process_index] = counts.get(d.process_index, 0) + 1
     return len(set(counts.values())) <= 1
 
 
@@ -487,8 +606,12 @@ def local_ranks() -> list:
 
 def process_of_rank(global_rank: int) -> int:
     """Index of the process owning chip ``global_rank`` (devices are
-    rank-ordered process-major)."""
-    return _get().devices[global_rank].process_index
+    rank-ordered process-major; loopback worlds carry the virtual
+    mapping explicitly — their fake devices all report process 0)."""
+    st = _get()
+    if st.rank_process_map is not None:
+        return st.rank_process_map[global_rank]
+    return st.devices[global_rank].process_index
 
 
 # ---------------------------------------------------------------------------

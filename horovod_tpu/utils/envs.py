@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import os
 
+from ..loopback import context as _lbctx
+
 # --- knob names (HVD_*; HOROVOD_* accepted as fallback) -------------------
 FUSION_THRESHOLD = "FUSION_THRESHOLD"  # bytes; reference default 128 MB (operations.cc:491-496)
 TRACED_FUSION_THRESHOLD = "TRACED_FUSION_THRESHOLD"  # bytes; 0 (default) = let XLA's combiner fuse traced collectives
@@ -67,6 +69,8 @@ RETRY_MAX_ATTEMPTS = "RETRY_MAX_ATTEMPTS"  # attempts per retried RPC/KV call
 RETRY_BACKOFF_MS = "RETRY_BACKOFF_MS"  # initial backoff between attempts
 RETRY_MAX_BACKOFF_MS = "RETRY_MAX_BACKOFF_MS"  # backoff growth cap
 RETRY_JITTER = "RETRY_JITTER"  # +/- fraction of deterministic jitter on backoff
+LOOPBACK = "LOOPBACK"  # "1" in loopback rank threads (hvd.loopback.world)
+LOOPBACK_TIMEOUT = "LOOPBACK_TIMEOUT"  # s per loopback collective rendezvous
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -131,16 +135,36 @@ def clear_overrides() -> None:
     _overrides.clear()
 
 
+def _overlay() -> dict | None:
+    """The loopback rank context's per-thread env overlay (the launcher
+    contract for rank THREADS — ``os.environ`` is shared by every rank
+    in one interpreter, so per-rank values live here). None outside a
+    loopback context."""
+    ctx = _lbctx.current()
+    return ctx.env if ctx is not None else None
+
+
 def is_env_fixed(name: str) -> bool:
     """True when the user pinned this knob via the environment — the
     autotuner must treat it as untunable (reference ``SetAutoTuning`` /
-    fixed params, ``operations.cc:490-523``)."""
+    fixed params, ``operations.cc:490-523``). A loopback overlay entry
+    counts: it is that rank's environment."""
+    ov = _overlay()
+    if ov is not None and any((p + name) in ov for p in _PREFIXES):
+        return True
     return any(os.environ.get(p + name) is not None for p in _PREFIXES)
 
 
 def get(name: str, default: str | None = None) -> str | None:
-    """Look up knob ``name``: environment (HVD_/HOROVOD_ prefixes) first,
-    then runtime overrides, then ``default``."""
+    """Look up knob ``name``: the loopback rank overlay (when on a rank
+    thread), then the environment (HVD_/HOROVOD_ prefixes), then runtime
+    overrides, then ``default``."""
+    ov = _overlay()
+    if ov is not None:
+        for prefix in _PREFIXES:  # both spellings, like the environ path
+            val = ov.get(prefix + name)
+            if val is not None:
+                return val
     for prefix in _PREFIXES:
         val = os.environ.get(prefix + name)
         if val is not None:
@@ -170,6 +194,17 @@ def set_env(name: str, value, *, only_if_unset: bool = False) -> None:
     prefix (the launcher/bootstrap side of the contract). Writing through
     the registry keeps the knob inventory centralized; ``only_if_unset``
     preserves an existing HVD_/HOROVOD_ spelling (``setdefault``)."""
+    ov = _overlay()
+    if ov is not None:
+        # On a loopback rank thread the write is rank-local: it must
+        # never leak into the interpreter-wide environment the other
+        # ranks (and the main thread) read.
+        if only_if_unset and (any((p + name) in ov for p in _PREFIXES)
+                              or any(os.environ.get(p + name) is not None
+                                     for p in _PREFIXES)):
+            return
+        ov["HVD_" + name] = str(value)
+        return
     if only_if_unset and any(
             os.environ.get(p + name) is not None for p in _PREFIXES):
         return

@@ -230,8 +230,49 @@ def stats() -> dict:
 
 
 def _crash(code: int) -> None:  # monkeypatched by tests
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    if ctx is not None:
+        # A loopback rank's "process death": os._exit would take every
+        # rank (the whole interpreter) down. Tear the rank down HERE —
+        # not only in the rank-thread wrapper — because the crash site
+        # may run on a rank-owned helper thread (the negotiation cycle
+        # loop, a retrying KV call): RankKilled would unwind just that
+        # thread while the watchdog kept beating, and peers would never
+        # notice the death. The abrupt stop ceases beats and fails this
+        # rank's own waiters with RankKilled, so the main thread unwinds
+        # as killed too.
+        ctx.dead = True
+        exc = _lbctx.RankKilled(code)
+        try:
+            from ..loopback import engine as _lbengine
+            _lbengine._abrupt_stop(ctx, reason=str(exc), exc=exc)
+        except Exception as e:
+            from . import logging as hvd_logging
+            hvd_logging.warning("loopback crash teardown failed: %s", e)
+        import threading
+        if (ctx.main_thread is not None
+                and threading.current_thread() is not ctx.main_thread):
+            # helper thread (cycle loop, retry ladder): die silently like
+            # a thread of a dead process — the rank's main thread unwinds
+            # as RankKilled through its failed waiters (threading swallows
+            # SystemExit in non-main threads; RankKilled here would only
+            # trip the unhandled-thread-exception hook)
+            raise SystemExit(code)
+        raise exc
     import os
     os._exit(code)
+
+
+def _caller_rank(spec: _Spec) -> int | None:
+    """Rank context for sites that don't pass one: a loopback rank
+    thread's overlay rank (each thread is its own "process"), else the
+    spec-load-time launcher rank."""
+    from ..loopback import context as _lbctx
+    if _lbctx.current() is not None:
+        r = envs.get_int(envs.RANK, -1)
+        return r if r >= 0 else None
+    return spec.default_rank
 
 
 def inject(site: str, *, rank: int | None = None,
@@ -243,13 +284,14 @@ def inject(site: str, *, rank: int | None = None,
     spec = _SPEC
     if spec is None:
         return
+    if rank is None:
+        rank = _caller_rank(spec)
     fired = None
     with spec.mu:
         for rule in spec.rules:
             if not rule.matches_site(site):
                 continue
-            if rule.should_fire(
-                    rank if rank is not None else spec.default_rank, step):
+            if rule.should_fire(rank, step):
                 fired = rule
                 break
     if fired is None:

@@ -340,7 +340,15 @@ def spawn_thread(target, *, name: str, daemon: bool = True,
     """Create AND start a thread. Under ``HVD_SCHED_CHECK=1`` a thread
     spawned while an hvdsched model run is active registers with the
     cooperative scheduler (it only runs when scheduled); outside a model
-    run — or with the knob unset — this is a plain daemon thread."""
+    run — or with the knob unset — this is a plain daemon thread.
+
+    A thread spawned from a loopback rank thread inherits that rank's
+    context (``horovod_tpu.loopback.context``): a rank-owned component's
+    worker threads — fusion-cycle timer, flush executor, negotiation
+    cycle, health watchdog — keep seeing the rank's world, not the
+    process-wide one."""
+    from ..loopback import context as _lbctx
+    target = _lbctx.bind_current(target)
     if _SCHED:
         return _sched_mod().spawn_thread(target, name=name, daemon=daemon,
                                          args=args, kwargs=kwargs or {})

@@ -46,6 +46,12 @@ def test_flush_on_synchronize_coalesces_whole_queue(hvd):
     assert st["pending_tensors"] == 6
     assert all(not h._entry.done for h in handles)
     out0 = hvd.synchronize(handles[0])  # flushes the WHOLE queue
+    # the batch's events are set in submission order after its one
+    # dispatch; settle the peers before asserting done-ness (synchronize
+    # only promises ITS entry — the whole-queue coalescing is what the
+    # dispatch/coalesce stats below pin down)
+    for h in handles[1:]:
+        hvd.synchronize(h)
     assert all(h._entry.done for h in handles)
     st = hvd.fusion_stats()
     assert st["flushes"]["synchronize"] == 1

@@ -12,9 +12,13 @@ import os
 import subprocess
 import sys
 import textwrap
+
 from backend_markers import skip_if_cpu_backend
 
-pytestmark = skip_if_cpu_backend
+# The spawn variants stay marked for real-hardware runs; the loopback
+# twins (the in-process driver in tests/test_loopback_world.py TestChaos/
+# TestElastic, and the `hvdrun --loopback --min-np` CLI test below) run
+# the same recovery protocol in tier-1 on the CPU backend.
 
 
 WORKER = textwrap.dedent("""\
@@ -72,6 +76,7 @@ DISCOVERY = textwrap.dedent("""\
 """)
 
 
+@skip_if_cpu_backend
 def test_elastic_grow_world(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
@@ -151,6 +156,7 @@ CRASH_DISCOVERY = textwrap.dedent("""\
 """)
 
 
+@skip_if_cpu_backend
 def test_elastic_worker_crash_recovery(tmp_path):
     """A worker dies mid-run; the survivor restores its last commit,
     re-rendezvouses into a shrunken world, and finishes — the analog of the
@@ -184,3 +190,72 @@ def test_elastic_worker_crash_recovery(tmp_path):
     assert sizes[0] == 2
     assert sizes[-1] == 1, sizes
     assert sorted(set(sizes)) == [1, 2]
+
+
+LOOPBACK_WORKER = textwrap.dedent("""\
+    import json
+    import sys
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+    import horovod_tpu as hvd
+
+    TRIGGER = sys.argv[1]
+    OUTFILE = sys.argv[2]
+
+    hvd.init()
+    state = hvd.elastic.JaxState(step=0, sizes=[])
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < 15 or \\
+                (2 not in state.sizes and state.step < 300):
+            out = hvd.allreduce(jnp.ones(2), op=hvd.Sum)
+            world = int(float(np.asarray(out).reshape(-1)[0]))
+            state.sizes = state.sizes + [world]
+            state.step += 1
+            if state.step == 2 and hvd.rank() == 0:
+                open(TRIGGER, "w").close()
+            time.sleep(0.05)
+            state.commit()
+        return state.sizes
+
+    sizes = train(state)
+    if hvd.rank() == 0:
+        with open(OUTFILE, "w") as f:
+            json.dump(sizes, f)
+    print("ELASTIC-DONE", hvd.rank(), len(sizes), flush=True)
+""")
+
+
+def test_elastic_grow_world_loopback(tmp_path):
+    """The loopback CLI twin of test_elastic_grow_world: `hvdrun
+    --loopback --min-np/--max-np` drives the REAL elastic driver over
+    rank threads — the world grows 1 -> 2 mid-run on the CPU backend
+    where the spawn variant must skip (docs/loopback.md)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(LOOPBACK_WORKER)
+    trigger = tmp_path / "trigger"
+    outfile = tmp_path / "sizes.json"
+    discovery = tmp_path / "discover.sh"
+    discovery.write_text(DISCOVERY.format(trigger=trigger))
+    discovery.chmod(0o755)
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "--loopback",
+         "-np", "1", "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", str(discovery),
+         "--start-timeout", "120",
+         "--", sys.executable, str(worker), str(trigger), str(outfile)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert outfile.exists(), proc.stdout
+    sizes = json.load(open(outfile))
+    assert sizes[0] == 1
+    assert sizes[-1] == 2, sizes
+    assert sorted(set(sizes)) == [1, 2]
+    assert len(sizes) < 300, "world never grew; job hit the bail-out cap"

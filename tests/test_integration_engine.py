@@ -1,6 +1,12 @@
 """Multi-process dynamic engine integration: real 2-process hvdrun jobs
 negotiating eager collectives over the launcher KV (the analog of the
-reference's mpirun-driven parallel tests)."""
+reference's mpirun-driven parallel tests).
+
+The ``skip_if_cpu_backend``-marked tests here stay as the real-hardware
+spawn variants; their loopback ports — identical semantics at world
+N in {2, 4}, running unconditionally in tier-1 — live in
+``tests/test_loopback_world.py`` (negotiation, per-process-set subsets,
+ragged allgather, join/zero-contribution, env-contract rejection)."""
 
 import os
 import subprocess

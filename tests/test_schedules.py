@@ -224,6 +224,44 @@ class TestPinnedRegressions:
 
 
 # ---------------------------------------------------------------------------
+# loopback world rendezvous (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+class TestLoopbackExchange:
+    """The loopback world's execution substrate under controlled
+    concurrency: the real hub must explore clean (it is also in the
+    MATRIX sweep above), and the planted unguarded-rendezvous bug must
+    be FOUND and replay byte-for-byte — world>1 chaos findings are
+    (seed, trace)-replayable instead of flaky (ISSUE-10 acceptance)."""
+
+    def test_unguarded_rendezvous_found_and_replays_byte_for_byte(
+            self, sched_check):
+        # the default schedule is clean: only exploration forces the
+        # check-vs-wait preemption window
+        run_model(models.DEMOS["loopback-exchange-unguarded"], seed=0)
+        result = explore(models.DEMOS["loopback-exchange-unguarded"],
+                         schedules=80, seed=0)
+        assert not result.ok, "planted loopback rendezvous bug not found"
+        f = result.findings[0]
+        assert f.kind == "lost-wakeup"
+        assert "lbdemo.cv" in str(f)
+        # byte-for-byte (seed, trace) replay: identical kind, decision
+        # trace, and report text
+        with pytest.raises(SchedFailure) as exc:
+            run_model(models.DEMOS["loopback-exchange-unguarded"],
+                      seed=f.seed, trace=f.trace)
+        f2 = exc.value
+        assert f2.kind == f.kind
+        assert f2.trace == f.trace
+        assert f2.report == f.report
+
+    def test_poisoned_round_outcomes_are_settled(self, sched_check):
+        # single-run sanity beyond the matrix sweep: a poison racing
+        # round 0 settles every rank with a result or the poison error
+        run_model(models.MATRIX["loopback-exchange"], seed=3)
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 

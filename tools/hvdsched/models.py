@@ -405,9 +405,92 @@ def pr6_unguarded():
     _starvation_model(guarded=False)
 
 
+def loopback_exchange():
+    """The loopback world's negotiation-round rendezvous (ISSUE 10): N
+    rank tasks race submit/exchange/deliver on the shared
+    ``LoopbackHub`` across two rounds while a watchdog-poison task fails
+    the world mid-flight. Contract: every participant either receives
+    its round's result or the poison error — no deadlock, no lost
+    wakeup, no waiter left parked. Some ranks completing a racing round
+    while others observe the poison is legal (exactly the real
+    coordinated-abort race); a rank recording NOTHING is not."""
+    inv = _inv()
+    from horovod_tpu.loopback.hub import LoopbackHub
+    hub = LoopbackHub("model")
+    n = 3
+    failed: list = []
+
+    def fail_check():
+        return RuntimeError("watchdog: peer dead") if failed else None
+
+    outcomes: list = [[] for _ in range(n)]
+
+    def rank(r):
+        for round_id in range(2):
+            try:
+                out = hub.exchange_compute(
+                    ("red", round_id), r, n, r + 1,
+                    lambda vals: sum(vals), timeout=30.0,
+                    failure_check=fail_check)
+                outcomes[r].append(out)
+            except RuntimeError as e:
+                outcomes[r].append(e)
+                return
+
+    ts = [inv.spawn_thread(rank, name=f"rank-{r}", args=(r,))
+          for r in range(n)]
+
+    def poisoner():
+        failed.append(1)
+        hub.fail_all(RuntimeError("watchdog: peer dead"))
+
+    tp = inv.spawn_thread(poisoner, name="watchdog")
+    for t in ts:
+        inv.join_thread(t)
+    inv.join_thread(tp)
+    for r in range(n):
+        if not outcomes[r]:
+            raise AssertionError(f"rank {r} recorded no outcome")
+        first = outcomes[r][0]
+        if not (isinstance(first, RuntimeError) or first == 6):
+            raise AssertionError(f"rank {r} round 0 outcome {first!r}")
+
+
 # ---------------------------------------------------------------------------
 # known-bad demos (exploration MUST find these)
 # ---------------------------------------------------------------------------
+
+
+def loopback_exchange_unguarded():
+    """The loopback rendezvous WITHOUT the hub's atomic check-and-wait:
+    the waiter tests slot completion OUTSIDE the condition lock, so a
+    peer completing the slot in that window notifies nobody and the
+    waiter parks forever — the lost-wakeup class
+    ``LoopbackHub.exchange_compute`` closes by re-checking under the
+    condition. Most schedules pass; exploration must FIND the window,
+    and the finding replays byte-for-byte from (seed, trace)."""
+    inv = _inv()
+    cv = inv.make_condition("lbdemo.cv")
+    slot = {"values": {}, "done": False, "result": None}
+    n = 2
+
+    def rank(r):
+        with cv:
+            slot["values"][r] = r + 1
+            if len(slot["values"]) == n:
+                slot["result"] = sum(slot["values"].values())
+                slot["done"] = True
+                cv.notify_all()
+                return
+        # BUG: completion check and wait are not atomic
+        if not slot["done"]:
+            with cv:
+                cv.wait()
+
+    ts = [inv.spawn_thread(rank, name=f"rank-{r}", args=(r,))
+          for r in range(n)]
+    for t in ts:
+        inv.join_thread(t)
 
 def deadlock_demo():
     """Classic two-lock inversion: T1 takes a then b, T2 takes b then
@@ -463,6 +546,7 @@ MATRIX = {
     "quiesce-race": quiesce_enqueue_race,
     "watchdog-abort": watchdog_poison_abort,
     "capture-replay-abort": capture_replay_abort,
+    "loopback-exchange": loopback_exchange,
     "pr3-issue-lock": pr3_issue_lock,
     "pr6-chain-guard": pr6_chain_guard,
 }
@@ -470,6 +554,7 @@ MATRIX = {
 DEMOS = {
     "deadlock-demo": deadlock_demo,
     "lost-wakeup-demo": lost_wakeup_demo,
+    "loopback-exchange-unguarded": loopback_exchange_unguarded,
     "pr3-unguarded": pr3_unguarded,
     "pr6-unguarded": pr6_unguarded,
 }
