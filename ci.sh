@@ -56,7 +56,7 @@ rm -f "$lint_json"
 # backend") are now SKIPPED via tests/backend_markers.py, so the dot
 # count is a clean signal. Raise this when the environment's pass level
 # rises; override with T1_MIN_PASSED.
-T1_MIN_PASSED="${T1_MIN_PASSED:-621}"
+T1_MIN_PASSED="${T1_MIN_PASSED:-652}"
 
 step "1/6 tier-1 gate (the ROADMAP.md command; floor: $T1_MIN_PASSED passed)"
 # faulthandler_timeout: a hung test (e.g. a flush-executor deadlock) dumps
@@ -161,6 +161,86 @@ step_bench_gate || {
   step_bench_gate || {
     echo "step bench attempt 2 failed; final retry in a fresh process"
     step_bench_gate
+  }
+}
+
+step "1m/6 metrics scrape gate (loopback world=4 /metrics completeness; docs/metrics.md)"
+# ISSUE-11 acceptance: a curl-able /metrics on the loopback world's KV
+# server exposes EVERY registered instrument (HELP/TYPE headers even
+# before first sample), every sample line parses, and the load-bearing
+# series are live at world=4: negotiation round latency, per-rank submit
+# lag, KV ops, and per-tenant fusion counters. A fault-injected slow
+# rank must be named in the straggler counter's labels.
+env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    HVD_FAULT_SPEC="svc.exchange:delay=0.4:rank=2:after=4" \
+    timeout -k 10 300 python - <<'EOF'
+import urllib.request
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu import metrics as m
+
+with hvd.loopback.world(4, extra_env={"HVD_STRAGGLER_THRESHOLD": "0.15"}) as w:
+    def body():
+        for i in range(8):
+            hvd.allreduce(jnp.ones(4), op=hvd.Sum, name=f"g{i}")
+        # async: rides the fusion queues, so the per-tenant flush
+        # counters are live series, not just registered headers
+        h = hvd.allreduce_async(jnp.ones(8), op=hvd.Sum, name="ga")
+        hvd.synchronize(h)
+        return "OK"
+    assert all(o.result == "OK" for o in w.run(body))
+    addr, port = w.kv_endpoint
+    text = urllib.request.urlopen(
+        f"http://{addr}:{port}/metrics", timeout=30).read().decode()
+
+for name, inst in sorted(m.instruments().items()):
+    assert f"# HELP {name} " in text, f"missing HELP for {name}"
+    assert f"# TYPE {name} {inst.kind}" in text, f"missing TYPE for {name}"
+samples = [l for l in text.splitlines() if l and not l.startswith("#")]
+for line in samples:
+    name_part, _, value = line.rpartition(" ")
+    float(value)  # every sample parses
+    assert name_part.split("{")[0].startswith("hvd_"), line
+assert len(samples) == len(set(samples)), "duplicate series in exposition"
+def series(prefix):
+    return [l for l in samples if l.startswith(prefix)]
+for r in range(4):
+    assert series(f'hvd_negotiation_rounds_total{{process_set="global",rank="{r}"}}'), r
+assert series("hvd_negotiation_round_seconds_count"), "no round latency"
+assert series("hvd_negotiation_submit_lag_seconds_count"), "no submit lag"
+assert series("hvd_kv_ops_total"), "no KV op counters"
+assert series('hvd_fusion_flushed_tensors_total{process_set="global"'), \
+    "no per-tenant fusion counters"
+strag = series('hvd_straggler_rounds_total{rank="2"')
+assert strag, "fault-injected slow rank 2 not named in straggler counter"
+print(f"metrics scrape OK: {len(samples)} samples, "
+      f"{len(m.instruments())} instruments, straggler series: {strag}")
+EOF
+
+step "1n/6 metrics overhead gate (HVD_METRICS=1 within 3% of off; docs/metrics.md)"
+# The registry's hot instruments ride the per-call dispatch path; the
+# interleaved ABBA microbench keeps box drift out of the comparison.
+# Same fresh-process retry policy as 1i: sub-3% deltas on the 2-core
+# CPU emulation carry scheduling luck; a real regression fails every
+# attempt.
+metrics_bench_gate() {
+python bench.py --metrics-bench | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+assert d['numerics_match'] is True, d
+assert d['value'] is not None and d['value'] <= 3.0, \
+    'metrics registry overhead beyond the 3%% contract: %r' % d
+print('metrics overhead OK: %.2f%% (%.4f -> %.4f ms/tensor)' % (
+    d['value'], d['metrics_off']['ms_per_tensor'],
+    d['metrics_on']['ms_per_tensor']))"
+}
+metrics_bench_gate || {
+  echo "metrics bench attempt 1 failed; retrying in a fresh process"
+  metrics_bench_gate || {
+    echo "metrics bench attempt 2 failed; final retry in a fresh process"
+    metrics_bench_gate
   }
 }
 

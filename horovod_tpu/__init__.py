@@ -122,6 +122,8 @@ from .exceptions import (
     PeerFailureError,
 )
 from .health import health_stats
+from . import metrics
+from .metrics import metrics_dump
 from .timeline import start_timeline, stop_timeline
 from . import autotune
 from . import callbacks
@@ -171,7 +173,7 @@ __all__ = [
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
-    "PeerFailureError", "health_stats",
+    "PeerFailureError", "health_stats", "metrics", "metrics_dump",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
     "checkpoint", "data", "elastic", "loopback", "parallel",
     "average_metrics",

@@ -52,6 +52,8 @@ def run_elastic(args, command: list[str]) -> int:
     for assignment in args.env:
         k, _, v = assignment.partition("=")
         extra_base[k] = v
+    if getattr(args, "metrics_port", None):
+        extra_base["HVD_METRICS_PORT"] = str(args.metrics_port)
 
     lb_world = None
     if getattr(args, "loopback", False):

@@ -27,6 +27,7 @@ import threading
 import time
 
 from . import health as _health
+from . import metrics as _metrics
 from . import timeline as _timeline
 from .loopback import context as _lbctx
 from .utils import invariants as _inv
@@ -75,16 +76,26 @@ class KVTransport:
         self.world_size = world_size
         self.rank = rank
         self.prefix = prefix
+        # Observability of the LAST exchange, read by the service's
+        # round-metrics hook: wall seconds publish->gathered, and each
+        # member's submit lag behind the round's first submitter
+        # (local rank -> seconds; server-receipt clock, so cross-host
+        # clock skew cannot fake a straggler).
+        self.last_round_s = 0.0
+        self.last_lags: dict[int, float] = {}
 
     def exchange(self, cycle: int, req_bytes: bytes, bits: bytes,
                  timeout: float) -> tuple[list[bytes], list[bytes]]:
         """One round: publish (requests, bits), collect everyone's."""
         import struct
         _faults.inject("svc.exchange")
+        t0 = time.monotonic()
         frame = struct.pack("<I", len(req_bytes)) + req_bytes + bits
         self.kv.put(f"{self.prefix}/x/{cycle}/{self.rank}", frame)
-        got = self.kv.gather(f"{self.prefix}/x/{cycle}", self.world_size,
-                             timeout=timeout)
+        got, times = self.kv.gather(f"{self.prefix}/x/{cycle}",
+                                    self.world_size, timeout=timeout,
+                                    with_times=True)
+        self.last_round_s = time.monotonic() - t0
         datas: list = [b""] * self.world_size
         bitvs: list = [b""] * self.world_size
         for k, v in got.items():
@@ -92,6 +103,14 @@ class KVTransport:
             (ln,) = struct.unpack_from("<I", v, 0)
             datas[r] = v[4:4 + ln]
             bitvs[r] = v[4 + ln:]
+        receipt: dict[int, float] = {}
+        for k, t in times.items():
+            try:
+                receipt[int(k.rsplit("/", 1)[1])] = t
+            except ValueError:
+                continue
+        first = min(receipt.values()) if receipt else 0.0
+        self.last_lags = {r: t - first for r, t in sorted(receipt.items())}
         # Everyone read cycle-c data before anyone can write cycle c+2 (a
         # process must finish cycle c+1's own reads first), so deleting our
         # *previous* cycle's keys here is safe and bounds KV memory.
@@ -133,9 +152,11 @@ class DynamicService:
     background thread."""
 
     def __init__(self, engine: NativeEngine, transport,
-                 cycle_time_s: float | None = None, global_ranks=None):
+                 cycle_time_s: float | None = None, global_ranks=None,
+                 pset_key: str = "global"):
         self.engine = engine
         self.transport = transport
+        self.pset_key = pset_key  # metrics process_set label
         # With no explicit value the knob is re-read every cycle so the
         # autotuner's CYCLE_TIME override takes effect live (the reference's
         # ParameterManager adjusts cycle time mid-run the same way).
@@ -176,6 +197,15 @@ class DynamicService:
                 # the elastic driver blacklists the right host.
                 global_ranks=global_ranks)
             self._watchdog.start()
+        # Straggler attribution over the transport's per-round submit
+        # lags (health.StragglerTracker, docs/metrics.md): counted and
+        # warned on busy rounds only — idle cycles' phase offsets are
+        # cadence jitter, not lag.
+        world = getattr(transport, "world_size", 1)
+        self._straggler = _health.StragglerTracker(
+            getattr(transport, "rank", 0),
+            (list(global_ranks) if global_ranks is not None
+             else list(range(world))))
         # Through the invariants seam: hvdsched can serialize the cycle
         # thread, and a loopback rank's cycle thread inherits that
         # rank's context (joined-rank zero executions run on it).
@@ -490,12 +520,16 @@ class DynamicService:
         # computed against the PRE-ingest cache state on every member (so
         # bit positions agree), the AND-served set commits first, and
         # ingest then skips served names — one KV round per cycle.
+        with self._mu:
+            busy = bool(self._pending)
         mine = self.engine.pop_requests()
         mybits = self.engine.cache_bits()
         cycle = self._cycle
         self._cycle += 1
         datas, bitvs = self.transport.exchange(cycle, mine, mybits,
                                                self._exchange_timeout)
+        if busy:
+            self._record_round_metrics()
         self.engine.commit_cache_bits(and_bitvectors(bitvs))
         for rank, data in enumerate(datas):
             self.engine.ingest(rank, data)
@@ -507,6 +541,35 @@ class DynamicService:
         if now - self._last_stall_check > _STALL_CHECK_INTERVAL_S:
             self._last_stall_check = now
             self._check_stalls()
+
+    def _record_round_metrics(self) -> None:
+        """Registry samples for one BUSY negotiation round (local work
+        was pending, so the round's latency and its members' submit lags
+        are load-bearing): the ROADMAP's protocol-scalability curve
+        (round latency + KV ops/round vs world) reads straight off
+        these, and the straggler tracker turns sustained lag into the
+        named-rank warning/counter (docs/metrics.md)."""
+        transport = self.transport
+        round_s = getattr(transport, "last_round_s", None)
+        if round_s is None:  # in-memory test transports: no KV timing
+            return
+        label = {"process_set": self.pset_key}
+        _metrics.NEGOTIATION_ROUNDS.inc(labels=label)
+        _metrics.NEGOTIATION_ROUND_SECONDS.observe(round_s, labels=label)
+        lags = getattr(transport, "last_lags", None) or {}
+        gr = self._straggler.global_ranks
+        for r in sorted(lags):
+            if 0 <= r < len(gr):
+                _metrics.NEGOTIATION_SUBMIT_LAG.observe(
+                    lags[r], labels={"rank": gr[r]})
+        with self._mu:
+            owed = sorted(self._pending)
+        self._straggler.observe(lags, owed)
+
+    def straggler_stats(self) -> dict:
+        """This service's straggler-attribution view
+        (``health.StragglerTracker.stats``)."""
+        return self._straggler.stats()
 
     def _deliver(self, responses: list[Response]):
         # While joined, responses for tensors this process never submitted
@@ -664,7 +727,14 @@ def get_service(pset=None) -> DynamicService | None:
             transport = KVTransport(kv, len(member_procs),
                                     member_procs.index(me), prefix=prefix)
             svc = DynamicService(engine, transport,
-                                 global_ranks=member_procs)
+                                 global_ranks=member_procs,
+                                 # one tenant, one label value: the
+                                 # global set is "global" here exactly
+                                 # as in the fusion counters
+                                 # (fusion_cycle._pset_label), so
+                                 # per-tenant series join across
+                                 # negotiation and fusion instruments
+                                 pset_key="global" if key == "0" else key)
             services[key] = svc
             hvd_logging.info(
                 "dynamic engine service started for set %s: %d processes "

@@ -37,6 +37,8 @@ import json
 import threading
 import time
 
+from . import metrics as _metrics
+from . import timeline as _timeline
 from .exceptions import PeerFailureError
 from .utils import envs
 from .utils import faults as _faults
@@ -193,6 +195,8 @@ class HealthWatchdog:
                     if not already:
                         self._failed = (rank, reason)
                 if not already:
+                    _metrics.HEALTH_PEER_FAILURES.inc(
+                        labels={"rank": rank})
                     hvd_logging.error(
                         "health watchdog: peer rank %d failed: %s",
                         rank, reason)
@@ -215,8 +219,10 @@ class HealthWatchdog:
                                     str(self._beat).encode()),
                 what="health.beat")
             self._beats_sent += 1
+            _metrics.HEALTH_BEATS.inc()
         except Exception as e:
             self._beat_errors += 1
+            _metrics.HEALTH_BEAT_ERRORS.inc()
             hvd_logging.warning("health: beat publish failed: %s", e)
 
     def _fetch_beats(self) -> dict[int, int] | None:
@@ -340,6 +346,102 @@ def make_peer_failure_error(dead_rank: int, reason: str,
                             owed_tensors=()) -> PeerFailureError:
     """The coordinated-abort error every waiter surfaces."""
     return PeerFailureError(dead_rank, reason, owed_tensors)
+
+
+class StragglerTracker:
+    """Per-negotiation-round straggler attribution — the *slow* half of
+    the failure spectrum the watchdog's *dead* half doesn't cover (the
+    reference stall inspector names ranks that never submitted; this
+    names ranks that submit **late**, docs/metrics.md).
+
+    Each busy negotiation round the KV transport reports every member's
+    submit lag (server-receipt clock, skew-free). A round whose last
+    submitter lags past ``HVD_STRAGGLER_THRESHOLD`` seconds:
+
+    * bumps ``hvd_straggler_rounds_total{rank=<global rank>}`` in the
+      metrics registry (the label names the straggler, so survivors'
+      series aggregate per culprit);
+    * drops a ``STRAGGLER.<rank>`` instant on the timeline's ``health``
+      lane;
+    * after ``sustain_rounds`` *consecutive* rounds blaming the same
+      rank, logs a rate-limited warning naming the global rank, its lag,
+      and the tensors this rank is still owed — the stall-check analog.
+
+    ``observe`` runs on the service's cycle thread only; ``stats`` may
+    be read from anywhere (tests assert the warning through it)."""
+
+    def __init__(self, my_rank: int, global_ranks, *,
+                 threshold_s: float | None = None,
+                 sustain_rounds: int = 3,
+                 warn_interval_s: float = 30.0):
+        self.rank = my_rank  # transport-local index of this member
+        self.global_ranks = list(global_ranks)
+        self.threshold_s = (threshold_s if threshold_s is not None
+                            else envs.straggler_threshold_s())
+        self.sustain_rounds = max(int(sustain_rounds), 1)
+        self.warn_interval_s = warn_interval_s
+        self._mu = _inv.make_lock("health.straggler.mu")
+        self._streak_rank: int | None = None  # local index
+        self._streak = 0
+        self._last_warn_at: dict[int, float] = {}  # global rank -> t
+        self._rounds: dict[int, int] = {}  # global rank -> count
+        self._warnings = 0
+        self._last_warning: str | None = None
+
+    def observe(self, lags: dict, owed_tensors=()) -> None:
+        """One busy round's per-member submit lags (local rank ->
+        seconds behind the round's first submitter)."""
+        if not lags:
+            return
+        worst = max(sorted(lags), key=lambda r: lags[r])
+        lag = lags[worst]
+        if worst == self.rank or lag < self.threshold_s:
+            # own lag is unobservable honestly (our put gates our
+            # gather), and an under-threshold round breaks any streak
+            with self._mu:
+                self._streak_rank = None
+                self._streak = 0
+            return
+        gr = self.global_ranks[worst]
+        _metrics.STRAGGLER_ROUNDS.inc(labels={"rank": gr})
+        _timeline.record_health_event(f"STRAGGLER.{gr}")
+        now = _inv.monotonic()
+        warn = None
+        with self._mu:
+            self._rounds[gr] = self._rounds.get(gr, 0) + 1
+            if self._streak_rank == worst:
+                self._streak += 1
+            else:
+                self._streak_rank = worst
+                self._streak = 1
+            if (self._streak >= self.sustain_rounds
+                    and now - self._last_warn_at.get(gr, float("-inf"))
+                    >= self.warn_interval_s):
+                self._last_warn_at[gr] = now
+                warn = (
+                    f"negotiation straggler: global rank {gr} was last "
+                    f"to submit for {self._streak} consecutive rounds, "
+                    f"{lag:.3f}s behind the first submitter "
+                    f"(HVD_STRAGGLER_THRESHOLD={self.threshold_s:g}s); "
+                    f"tensors owed to this rank: "
+                    f"{sorted(owed_tensors)}")
+                self._warnings += 1
+                self._last_warning = warn
+        if warn is not None:
+            hvd_logging.warning("%s", warn)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "threshold_s": self.threshold_s,
+                "straggler_rounds": dict(sorted(self._rounds.items())),
+                "current_streak": (
+                    None if self._streak_rank is None
+                    else {"rank": self.global_ranks[self._streak_rank],
+                          "rounds": self._streak}),
+                "warnings": self._warnings,
+                "last_warning": self._last_warning,
+            }
 
 
 # -- process-wide registry + the hvd.health_stats() surface -----------------

@@ -58,6 +58,10 @@ class RankContext:
         "xseq",
         # elastic worker-side singletons (per rank, not per process)
         "notification_manager", "worker_rendezvous",
+        # metrics.py keeps per-rank value stores in a WeakKeyDictionary
+        # keyed by this context, so a dead world's samples are collected
+        # with it
+        "__weakref__",
     )
 
     def __init__(self, world, rank: int, env: dict | None = None,

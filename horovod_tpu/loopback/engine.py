@@ -198,6 +198,13 @@ class LoopbackWorld:
             self._secret = secret
         self._handles: list[RankThread] = []
 
+    @property
+    def kv_endpoint(self) -> tuple:
+        """``(addr, port)`` of the world's KV server. Its ``/metrics``
+        route serves every rank's registry store rank-labeled
+        (docs/metrics.md) — the tier-1 scrape surface for world>1."""
+        return self._kv_addr, self._kv_port
+
     # -- env contract ------------------------------------------------------
 
     def rank_env(self, rank: int, size: int, *, extra=None) -> dict:

@@ -42,6 +42,7 @@ from collections import OrderedDict
 from typing import Callable
 
 from .. import autotune as _autotune
+from .. import metrics as _metrics
 from .. import timeline as _timeline
 from ..utils import envs
 from ..utils import invariants as _inv
@@ -108,14 +109,14 @@ def _ctx_store():
     if ctx.plans is None:
         ctx.plans = OrderedDict()
     return ctx
-_hits = 0
-_misses = 0
-_invalidations = 0
-_evictions = 0
-_negotiation_skips = 0
-_chunked_builds = 0
-_step_builds = 0
 
+
+# Counter storage lives in the unified metrics registry (metrics.py,
+# ``always=True`` instruments — recording survives HVD_METRICS=0 because
+# these back the hvd.dispatch_cache_stats() API). A loopback rank's
+# lookups land in its OWN registry store, matching its per-rank plan map:
+# one rank's counters never bleed into a peer's view.
+#
 # Where a plan hit was served from: "call" (direct eager collective),
 # "flush" (a fusion-cycle flush coalescing a queue), or "step" (the
 # step capture-and-replay program, ops/step_capture.py). Per-source hit
@@ -123,7 +124,6 @@ _step_builds = 0
 # a replayed step serves ONE step-plan hit where the per-flush path
 # would have served one hit per flush.
 _SOURCES = ("call", "flush", "step")
-_hits_by_source = {s: 0 for s in _SOURCES}
 _tls = threading.local()
 
 
@@ -172,10 +172,9 @@ def _flush_locked(count_invalidation: bool) -> None:
 
 
 def _flush_store_locked(plans, count_invalidation: bool) -> None:
-    global _invalidations
     _inv.assert_holding(_lock, "dispatch_cache plan-map flush")
-    if count_invalidation:
-        _invalidations += len(plans)
+    if count_invalidation and plans:
+        _metrics.DISPATCH_INVALIDATIONS.inc(len(plans))
     plans.clear()
 
 
@@ -204,7 +203,7 @@ def lookup(key: tuple, source: str | None = None,
     the lookup itself stays silent and the hit is counted only when a
     replay actually serves (:func:`note_step_hit`), so the counters
     reflect work served, not state-machine traffic."""
-    global _hits, _misses, _epoch
+    global _epoch
     if capacity() <= 0:
         return None
     epoch = _current_epoch()
@@ -216,14 +215,13 @@ def lookup(key: tuple, source: str | None = None,
         plan = plans.get(key)
         if plan is None:
             if record_stats:
-                _misses += 1
+                _metrics.DISPATCH_MISSES.inc()
             return None
         plans.move_to_end(key)
         if plan is UNPLANNABLE:
             return plan  # negative decision: neither a hit nor a miss
         if record_stats:
-            _hits += 1
-            _hits_by_source[src] = _hits_by_source.get(src, 0) + 1
+            _metrics.DISPATCH_HITS.inc(labels={"source": src})
     if record_stats:
         _timeline.record_dispatch(plan.label, hit=True)
     return plan
@@ -234,17 +232,14 @@ def note_step_hit() -> None:
     called by the capture controller when the whole-step program
     actually executes, so step hits equal replayed steps exactly — an
     armed-then-diverged step never counts."""
-    global _hits
-    with _lock:
-        _hits += 1
-        _hits_by_source["step"] = _hits_by_source.get("step", 0) + 1
+    _metrics.DISPATCH_HITS.inc(labels={"source": "step"})
     _timeline.record_dispatch("step", hit=True)
 
 
 def store(key: tuple, plan: DispatchPlan) -> None:
     """Insert ``plan`` (LRU-evicting past capacity). No-op when caching is
     disabled, so the build-per-call path stays allocation-clean."""
-    global _evictions, _epoch, _chunked_builds, _step_builds
+    global _epoch
     cap = capacity()
     if cap <= 0:
         return
@@ -253,15 +248,15 @@ def store(key: tuple, plan: DispatchPlan) -> None:
     plans = ctx.plans if ctx is not None else _plans
     with _lock:
         if plan is not UNPLANNABLE and plan.variant == "chunked":
-            _chunked_builds += 1
+            _metrics.DISPATCH_CHUNKED_BUILDS.inc()
         if plan is not UNPLANNABLE and plan.variant == "step":
-            _step_builds += 1
+            _metrics.DISPATCH_STEP_BUILDS.inc()
         _sync_epoch_locked(ctx, plans, epoch)
         plans[key] = plan
         plans.move_to_end(key)
         while len(plans) > cap:
             plans.popitem(last=False)
-            _evictions += 1
+            _metrics.DISPATCH_EVICTIONS.inc()
     if plan is not UNPLANNABLE:
         _timeline.record_dispatch(plan.label, hit=False)
 
@@ -283,35 +278,45 @@ def note_negotiation_skip() -> None:
     """Account one negotiation round skipped — either the plan pinned the
     no-service decision, or the engine served the round from its response
     cache (``from_cache``, the reference's bitvector HIT path)."""
-    global _negotiation_skips
-    _negotiation_skips += 1
+    _metrics.DISPATCH_NEGOTIATION_SKIPS.inc()
 
 
 def stats() -> dict:
-    """Plan-cache counters (the ``hvd.dispatch_cache_stats()`` API)."""
+    """Plan-cache counters (the ``hvd.dispatch_cache_stats()`` API) —
+    a view over the unified metrics registry, shape-identical to the
+    pre-registry dicts. On a loopback rank thread the view (like the
+    rank's plan map) is that rank's own."""
+    by_source = {s: 0 for s in _SOURCES}
+    for labelitems, v in _metrics.DISPATCH_HITS.series().items():
+        by_source[dict(labelitems).get("source", "call")] = int(v)
+    ctx = _ctx_store()
+    plans = ctx.plans if ctx is not None else _plans
     with _lock:
-        return {
-            "enabled": enabled(),
-            "capacity": capacity(),
-            "size": len(_plans),
-            "hits": _hits,
-            "hits_by_source": dict(_hits_by_source),
-            "misses": _misses,
-            "invalidations": _invalidations,
-            "evictions": _evictions,
-            "negotiation_skips": _negotiation_skips,
-            "chunked_builds": _chunked_builds,
-            "step_builds": _step_builds,
-        }
+        size = len(plans)
+    return {
+        "enabled": enabled(),
+        "capacity": capacity(),
+        "size": size,
+        "hits": sum(by_source.values()),
+        "hits_by_source": by_source,
+        "misses": int(_metrics.DISPATCH_MISSES.value()),
+        "invalidations": int(_metrics.DISPATCH_INVALIDATIONS.value()),
+        "evictions": int(_metrics.DISPATCH_EVICTIONS.value()),
+        "negotiation_skips": int(
+            _metrics.DISPATCH_NEGOTIATION_SKIPS.value()),
+        "chunked_builds": int(_metrics.DISPATCH_CHUNKED_BUILDS.value()),
+        "step_builds": int(_metrics.DISPATCH_STEP_BUILDS.value()),
+    }
 
 
 def reset_stats() -> None:
-    global _hits, _misses, _invalidations, _evictions, _negotiation_skips
-    global _chunked_builds, _step_builds, _hits_by_source
-    with _lock:
-        _hits = _misses = _invalidations = _evictions = 0
-        _negotiation_skips = _chunked_builds = _step_builds = 0
-        _hits_by_source = {s: 0 for s in _SOURCES}
+    for inst in (_metrics.DISPATCH_HITS, _metrics.DISPATCH_MISSES,
+                 _metrics.DISPATCH_INVALIDATIONS,
+                 _metrics.DISPATCH_EVICTIONS,
+                 _metrics.DISPATCH_NEGOTIATION_SKIPS,
+                 _metrics.DISPATCH_CHUNKED_BUILDS,
+                 _metrics.DISPATCH_STEP_BUILDS):
+        inst.reset()
 
 
 def reset() -> None:

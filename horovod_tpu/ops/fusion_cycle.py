@@ -97,6 +97,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import autotune as _autotune
+from .. import metrics as _metrics
 from .. import timeline as _timeline
 from ..utils import envs
 from ..utils import faults as _faults
@@ -112,6 +113,52 @@ FLUSH_TRIGGERS = ("threshold", "cycle", "synchronize", "poll", "barrier",
 # In-flight window multiplier: after a dispatch the scheduler flushes at
 # the PENDING_CYCLE_TIME pace for one cycle window (see _age_limit_s).
 _INFLIGHT_WINDOW_CYCLES = 1.0
+
+
+# Bound registry series for the enqueue/flush hot paths: label
+# resolution paid once per (tenant, trigger), after which a sample is a
+# dict update under the registry's leaf lock (docs/metrics.md overhead
+# contract; benign rebind race under the GIL).
+_PENDING_BYTES_G = _metrics.FUSION_PENDING_BYTES.bind()
+_INFLIGHT_DEPTH_G = _metrics.PIPELINE_INFLIGHT_DEPTH.bind()
+_tenant_series: dict = {}
+
+
+def _tenant_metrics(tenant: str) -> dict:
+    t = _tenant_series.get(tenant)
+    if t is None:
+        t = _tenant_series[tenant] = {
+            "enqueued": _metrics.FUSION_ENQUEUED_TENSORS.bind(
+                {"process_set": tenant}),
+            "tensors": _metrics.FUSION_FLUSHED_TENSORS.bind(
+                {"process_set": tenant}),
+            "bytes": _metrics.FUSION_FLUSHED_BYTES.bind(
+                {"process_set": tenant}),
+            "flushes": {},  # trigger -> bound counter
+        }
+    return t
+
+
+def _flush_counter(tm: dict, tenant: str, trigger: str):
+    c = tm["flushes"].get(trigger)
+    if c is None:
+        c = tm["flushes"][trigger] = _metrics.FUSION_FLUSHES.bind(
+            {"process_set": tenant, "trigger": trigger})
+    return c
+
+
+def _pset_label(pset) -> str:
+    """Tenant label for the registry's per-process-set fusion counters
+    (the multi-tenant QoS seam): THE derivation is
+    ``engine_service._set_key`` — one function, so fusion and
+    negotiation instruments can never drift apart on a tenant's label —
+    with the global set's ``"0"`` key spelled ``"global"`` (the engine
+    service applies the same mapping to its ``pset_key``)."""
+    if pset is None or getattr(pset, "is_global", True):
+        return "global"
+    from .. import engine_service as _es
+    key = _es._set_key(pset)
+    return "global" if key == "0" else key
 
 
 def enabled() -> bool:
@@ -313,9 +360,12 @@ class FusionScheduler:
             self._pending_bytes += entry.nbytes
             self._stats["enqueued_tensors"] += entry.count
             self._stats["enqueued_bytes"] += entry.nbytes
+            pending_bytes = self._pending_bytes
             over_threshold = q.nbytes >= envs.fusion_threshold_bytes()
             over_pending = self._pending_bytes >= max_pending_bytes()
             self._ensure_thread_locked()
+        _tenant_metrics(_pset_label(spec.pset))["enqueued"].inc(entry.count)
+        _PENDING_BYTES_G.set(pending_bytes)
         for name in entry.names:
             _timeline.record_queue_enqueue(name or entry.label)
         self._wake.set()
@@ -367,6 +417,13 @@ class FusionScheduler:
                 if svc_names:
                     with self._exec_cv:
                         self._exec_names.update(svc_names)
+            pending_bytes = self._pending_bytes
+        tenant = _pset_label(q.spec.pset)
+        tm = _tenant_metrics(tenant)
+        _flush_counter(tm, tenant, trigger).inc()
+        tm["tensors"].inc(sum(e.count for e in entries))
+        tm["bytes"].inc(q.nbytes)
+        _PENDING_BYTES_G.set(pending_bytes)
         _timeline.record_cycle_flush(trigger)
         # Step capture recording: composition noted at the drain point
         # (submission order), while the entries still hold their tensors.
@@ -576,6 +633,7 @@ class FusionScheduler:
             if waited:
                 self._pstats["slot_waits"] += 1
                 self._pstats["device_wait_ms"] += wait_s * 1e3
+        _INFLIGHT_DEPTH_G.set(depth)
         _timeline.record_inflight_depth(depth)
 
     def _track_inflight(self, entries: list[_Entry]) -> None:

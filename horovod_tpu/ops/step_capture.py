@@ -65,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import autotune as _autotune
+from .. import metrics as _metrics
 from .. import timeline as _timeline
 from ..utils import envs
 from ..utils import invariants as _inv
@@ -388,6 +389,23 @@ def _store_key(key: tuple) -> tuple:
             key)
 
 
+# Registry mirror of the capture lifecycle (docs/metrics.md): a numeric
+# phase gauge plus per-event counters. The per-instance `_stats` dict
+# stays the `fusion_stats()["capture"]` storage (tests build standalone
+# schedulers whose capture counters must not mix); the registry mirror
+# is the scrapeable view.
+_PHASE_CODES = {"idle": 0, "record": 1, "replay": 2, "replayed": 3,
+                "bypass": 4}
+
+
+def _note_capture(event: str | None = None,
+                  state: str | None = None) -> None:
+    if event is not None:
+        _metrics.STEP_CAPTURE_STEPS.inc(labels={"event": event})
+    if state is not None:
+        _metrics.STEP_CAPTURE_PHASE.set(_PHASE_CODES.get(state, 0))
+
+
 class CaptureState:
     """Capture lifecycle controller owned by one
     :class:`~horovod_tpu.ops.fusion_cycle.FusionScheduler`.
@@ -479,6 +497,7 @@ class CaptureState:
                 if not isinstance(plan, StepPlan):
                     # epoch flush / eviction / capacity 0 dropped it
                     self._stats["invalidations"] += 1
+                    _note_capture("invalidated")
                     self._last_key = None
                     plan = None
             if plan is not None:
@@ -492,6 +511,7 @@ class CaptureState:
                 # plan could never be stored, so recording every step
                 # would only burn bookkeeping — stay eager for the region
                 self._state = "bypass"
+        _note_capture(state=self._state)
         _timeline.record_capture(
             "REPLAY" if self._replaying
             else ("RECORD" if self._recording else "BYPASS"))
@@ -503,6 +523,7 @@ class CaptureState:
             return
         self._stats["recorded_steps"] += 1
         self._stats["captured_flushes"] += len(records)
+        _note_capture("recorded")
         key = tuple(r.signature() for r in records)
         cached = _dispatch.lookup(_store_key(key), record_stats=False)
         if isinstance(cached, StepPlan):
@@ -515,6 +536,7 @@ class CaptureState:
             plan = None
         if plan is None:
             self._stats["uncapturable_steps"] += 1
+            _note_capture("uncapturable")
             self._last_key = None
             return
         self._stats["plan_builds"] += 1
@@ -648,6 +670,8 @@ class CaptureState:
     def _diverge_locked(self) -> None:
         self._stats["fallbacks"] += 1
         self._stats["invalidations"] += 1
+        _note_capture("fallback", state="bypass")
+        _note_capture("invalidated")
         self._plan = None
         self._last_key = None
         self._expect = {}
@@ -710,6 +734,7 @@ class CaptureState:
             hvd_logging.error("step replay failed: %s", exc)
             with self._mu:
                 self._stats["invalidations"] += 1
+                _note_capture("invalidated")
                 self._plan = None
                 self._last_key = None
             if not isinstance(exc, Exception):
@@ -726,6 +751,7 @@ class CaptureState:
         with self._mu:
             self._stats["replayed_steps"] += 1
             self._stats["replayed_entries"] += len(entries)
+        _note_capture("replayed", state="replayed")
         _timeline.record_capture("REPLAY_DONE")
 
     # -- interception / teardown -------------------------------------------
@@ -779,6 +805,7 @@ class CaptureState:
             if (self._plan is not None or self._last_key is not None
                     or self._state in ("record", "replay")):
                 self._stats["invalidations"] += 1
+                _note_capture("invalidated")
             self._plan = None
             self._last_key = None
             self._matched = self._total = 0

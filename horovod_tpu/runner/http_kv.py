@@ -24,6 +24,7 @@ import time
 import urllib.error
 import urllib.request
 
+from .. import metrics as _metrics
 from ..utils import faults as _faults
 from ..utils import logging as hvd_logging
 from ..utils import retry as _retry
@@ -85,6 +86,22 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if self.path.rstrip("/") == "/metrics":
+            # Prometheus exposition (docs/metrics.md): unsigned by
+            # design — scrapers can't HMAC, and the payload is derived
+            # telemetry (instrument samples), never KV values/secrets.
+            # Serves THIS process's registry: for a loopback world every
+            # rank's store (rank-labeled); for a real launcher the
+            # driver-side view (workers serve their own on
+            # HVD_METRICS_PORT).
+            body = _metrics.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if not self._verify("GET", b""):
             self._reject()
             return
@@ -115,9 +132,13 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         """Long-poll collect: ``__gather__/<scope>?count=N&timeout=S``
         blocks until N keys exist under scope, then returns them framed
         (sorted; u32 count, then per entry u32 klen + key + u32 vlen +
-        value). Turns the engine transport's O(world) GET polls per cycle
-        into one request per member (reference analog: the controller's
-        single MPI_Gatherv, ``mpi_controller.cc:135-179``)."""
+        value, then one f64 **server receipt time** per entry in the
+        same order — a single clock for every member's PUT, which is
+        what makes per-rank submit-lag attribution skew-free; old
+        clients simply ignore the trailing section). Turns the engine
+        transport's O(world) GET polls per cycle into one request per
+        member (reference analog: the controller's single MPI_Gatherv,
+        ``mpi_controller.cc:135-179``)."""
         import struct
         from urllib.parse import parse_qs, urlparse
         parsed = urlparse(key)
@@ -130,6 +151,7 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         # are woken by do_PUT — no poll loop, no lock churn: one wakeup per
         # write instead of O(world) threads re-acquiring the lock ~500x/s.
         cond = self.server.lock  # type: ignore[attr-defined]
+        times = self.server.times  # type: ignore[attr-defined]
         with cond:
             ready = cond.wait_for(
                 lambda: sum(k.startswith(prefix) for k in store) >= count,
@@ -146,6 +168,8 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
                 v = store[k]
                 parts.append(struct.pack("<I", len(kb)) + kb
                              + struct.pack("<I", len(v)) + v)
+            parts.extend(struct.pack("<d", times.get(k, 0.0))
+                         for k in keys)
             body = b"".join(parts)
         self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
@@ -161,6 +185,9 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         key = self._key()
         with self.server.lock:  # type: ignore[attr-defined]
             self.server.store[key] = payload  # type: ignore[attr-defined]
+            # receipt time on the SERVER clock: one comparable clock for
+            # every member's PUT (per-rank submit-lag attribution)
+            self.server.times[key] = time.monotonic()  # type: ignore[attr-defined]
             self.server.lock.notify_all()  # wake parked gather handlers
         observer = getattr(self.server, "on_put", None)
         if observer is not None:
@@ -186,6 +213,7 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
             for k in [k for k in store
                       if k == key or k.startswith(key.rstrip("/") + "/")]:
                 del store[k]
+                self.server.times.pop(k, None)  # type: ignore[attr-defined]
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -210,6 +238,7 @@ class KVServer:
     def start(self, port: int = 0) -> int:
         self._httpd = _ThreadedHTTPServer(("0.0.0.0", port), KVHandler)
         self._httpd.store = {}  # type: ignore[attr-defined]
+        self._httpd.times = {}  # type: ignore[attr-defined]  # key receipt times
         # Condition (not a bare Lock): gather long-polls park on it and
         # do_PUT wakes them, instead of each blocked handler polling.
         self._httpd.lock = threading.Condition()  # type: ignore[attr-defined]
@@ -229,6 +258,7 @@ class KVServer:
         assert self._httpd is not None
         with self._httpd.lock:  # type: ignore[attr-defined]
             self._httpd.store[key] = value  # type: ignore[attr-defined]
+            self._httpd.times[key] = time.monotonic()  # type: ignore[attr-defined]
             self._httpd.lock.notify_all()  # type: ignore[attr-defined]
 
     def get(self, key: str) -> bytes | None:
@@ -274,6 +304,12 @@ class KVClient:
     def _request(self, method: str, path: str, payload: bytes = b"",
                  timeout: float | None = None):
         _faults.inject(f"kv.{method.lower()}")
+        # One sample per server round trip (retries are separate trips);
+        # divided by hvd_negotiation_rounds_total this is the protocol-
+        # scalability "KV ops/round" curve (docs/metrics.md).
+        _metrics.KV_OPS.inc(labels={
+            "op": ("gather" if path.startswith("/__gather__/")
+                   else method.lower())})
         req = urllib.request.Request(
             f"{self._base}{path}", data=payload if method == "PUT" else None,
             method=method)
@@ -336,10 +372,15 @@ class KVClient:
                 return val
         raise TimeoutError(f"KV key {key!r} not set within {timeout}s")
 
-    def gather(self, scope: str, count: int, timeout: float = 60.0) -> dict:
+    def gather(self, scope: str, count: int, timeout: float = 60.0,
+               with_times: bool = False):
         """Collect ``count`` keys under ``scope`` in one server-side
         long-poll (server assembles; one HTTP round trip per call instead
-        of one poll loop per key). Returns {key: value}."""
+        of one poll loop per key). Returns {key: value}; with
+        ``with_times`` returns ``({key: value}, {key: server receipt
+        seconds})`` — the server-clock PUT timestamps the negotiation
+        transport turns into per-rank submit lags (older servers without
+        the trailing section yield an empty times dict)."""
         import struct
         deadline = time.monotonic() + timeout
         while True:
@@ -371,6 +412,7 @@ class KVClient:
             out = {}
             pos = 4
             (n,) = struct.unpack_from("<I", data, 0)
+            keys = []
             for _ in range(n):
                 (klen,) = struct.unpack_from("<I", data, pos)
                 pos += 4
@@ -380,7 +422,16 @@ class KVClient:
                 pos += 4
                 out[k] = data[pos:pos + vlen]
                 pos += vlen
-            return out
+                keys.append(k)
+            if not with_times:
+                return out
+            times = {}
+            if len(data) - pos >= 8 * n:
+                for k in keys:  # same order as the entry section
+                    (t,) = struct.unpack_from("<d", data, pos)
+                    pos += 8
+                    times[k] = t
+            return out, times
 
 
 @functools.lru_cache(maxsize=1)

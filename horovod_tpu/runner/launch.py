@@ -82,6 +82,12 @@ def parse_args(argv=None):
     parser.add_argument("--disable-cache", action="store_true",
                         help="set HVD_CACHE_CAPACITY=0 in workers")
     parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="expose each worker's Prometheus /metrics "
+                             "on this base port + its rank (seeds "
+                             "HVD_METRICS_PORT; docs/metrics.md). The "
+                             "launcher KV server always serves its own "
+                             "/metrics route")
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--env", action="append", default=[],
                         metavar="NAME=VALUE", help="extra env for workers")
@@ -392,6 +398,8 @@ def run_static(args, command: list[str]) -> int:
         extra["HVD_CACHE_CAPACITY"] = "0"
     if args.timeline_filename:
         extra["HVD_TIMELINE"] = args.timeline_filename
+    if args.metrics_port:
+        extra["HVD_METRICS_PORT"] = str(args.metrics_port)
     if args.autotune:
         extra["HVD_AUTOTUNE"] = "1"
 

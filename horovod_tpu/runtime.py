@@ -113,7 +113,8 @@ def init(
         if _state is not None:
             hvd_logging.debug("init() called twice; ignoring")
             return
-        _generation += 1
+        # re-init epoch, not telemetry (keys cache invalidation)
+        _generation += 1  # hvdlint: disable=metrics-registry
 
         _maybe_distributed_init()
 
@@ -152,6 +153,10 @@ def init(
     # Outside the lock: timeline autostart builds the native engine.
     from . import timeline as _timeline
     _timeline.maybe_autostart()
+    # Per-worker Prometheus exposition when HVD_METRICS_PORT is seeded
+    # (hvdrun --metrics-port); idempotent across elastic re-inits.
+    from . import metrics as _metrics
+    _metrics.maybe_serve()
     # Multi-process jobs start the negotiation service now (the analog of
     # the reference spawning BackgroundThreadLoop inside init,
     # operations.cc:811-864): every process must tick cycles even before
@@ -205,6 +210,11 @@ def _loopback_init(ctx, *, axis_name: str = AXIS_NAME,
     hvd_logging.info(
         "loopback initialized: rank %d of %d (world %s)", rank, size,
         envs.get(envs.COORDINATOR_ADDR, "?"))
+    # HVD_TIMELINE works in loopback worlds too: the first rank's init
+    # starts the one shared writer; every rank's events carry a
+    # rank<N>/ lane prefix (the ISSUE-11 attribution fix).
+    from . import timeline as _timeline
+    _timeline.maybe_autostart()
     from . import engine_service as _engine_service
     _engine_service.get_service()
 

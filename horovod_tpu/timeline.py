@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 
+from .loopback import context as _lbctx
 from .utils import envs
 from .utils import logging as hvd_logging
 
@@ -100,7 +101,14 @@ def maybe_autostart() -> None:
     if not path or path.upper() == "DYNAMIC" or _active:
         return
     from . import runtime
-    if runtime.process_count() > 1:
+    if _lbctx.current() is not None:
+        # Loopback rank threads share ONE process and so one writer:
+        # the first rank's init starts the single file and every rank's
+        # events land in it with a ``rank<N>/`` lane prefix (see
+        # :func:`record`) — a per-rank ``.<rank>`` suffix here would
+        # just mislabel the shared file with whichever rank won init.
+        pass
+    elif runtime.process_count() > 1:
         path = f"{path}.{runtime.process_rank()}"
     try:
         start_timeline(path)
@@ -170,9 +178,10 @@ def record_retry(what: str, attempt: int) -> None:
 
 
 def record_health_event(event: str) -> None:
-    """Instant marker on the ``health`` lane for watchdog state changes
-    (``PEER_DEAD.<rank>``, ``POISON``) so a coordinated abort is
-    attributable on the trace."""
+    """Instant marker on the ``health`` lane for failure-domain state
+    changes (``PEER_DEAD.<rank>``, ``POISON``, ``STRAGGLER.<rank>``) so
+    a coordinated abort — or a sustained straggler — is attributable on
+    the trace."""
     if _active:
         record(HEALTH_LANE, event, PHASE_INSTANT)
 
@@ -191,11 +200,19 @@ def pipeline_stage(stage: str) -> "op_range":
 
 def record(tensor: str, activity: str, phase: int) -> None:
     """Record one event when the timeline is active (cheap no-op guard on
-    the hot path)."""
+    the hot path). Loopback rank threads share ONE process — and so one
+    writer and one file — so the lane is prefixed with the thread's rank
+    from the :class:`~horovod_tpu.loopback.context.RankContext`: every
+    rank's events stay attributable in the single merged trace (the
+    multi-process path gets the same attribution from
+    ``maybe_autostart``'s per-process ``<path>.<rank>`` files)."""
     if not _active:
         return
     eng = _engine
     if eng is not None:
+        ctx = _lbctx.current()
+        if ctx is not None:
+            tensor = f"rank{ctx.rank}/{tensor}"
         eng.timeline_record(tensor, activity, phase)
 
 
@@ -235,6 +252,28 @@ def merge_timelines(inputs, output: str) -> int:
     return len(events)
 
 
+# jax.profiler.TraceAnnotation, resolved ONCE: op_range.__enter__ sits on
+# every eager collective's hot path, and the previous per-call
+# ``import jax.profiler`` under a blanket ``except Exception`` paid the
+# sys.modules lookup + attribute walk (and re-paid the full failed-import
+# machinery forever on hosts without the profiler) once per op. None with
+# ``_ann_failed`` set = resolution failed and stays failed; the timeline
+# half of op_range keeps working either way.
+_ann_cls = None
+_ann_failed = False
+
+
+def _annotation_cls():
+    global _ann_cls, _ann_failed
+    if _ann_cls is None and not _ann_failed:
+        try:
+            from jax.profiler import TraceAnnotation
+            _ann_cls = TraceAnnotation
+        except Exception:  # profiler unavailable: cache the failure
+            _ann_failed = True
+    return _ann_cls
+
+
 class op_range:
     """Context manager tracing one eager collective: begin/end records in
     the Chrome timeline plus a ``jax.profiler.TraceAnnotation`` range so
@@ -250,13 +289,14 @@ class op_range:
     def __enter__(self):
         if _active:
             record(self.tensor, self.activity, PHASE_BEGIN)
-            try:
-                import jax.profiler
-                self._ann = jax.profiler.TraceAnnotation(
-                    f"hvd.{self.activity}.{self.tensor}")
-                self._ann.__enter__()
-            except Exception:  # profiler unavailable: timeline still works
-                self._ann = None
+            cls = _annotation_cls()
+            if cls is not None:
+                try:
+                    self._ann = cls(
+                        f"hvd.{self.activity}.{self.tensor}")
+                    self._ann.__enter__()
+                except Exception:  # a broken annotation must not break
+                    self._ann = None  # the collective or the timeline
         return self
 
     def __exit__(self, *exc):

@@ -71,6 +71,9 @@ RETRY_MAX_BACKOFF_MS = "RETRY_MAX_BACKOFF_MS"  # backoff growth cap
 RETRY_JITTER = "RETRY_JITTER"  # +/- fraction of deterministic jitter on backoff
 LOOPBACK = "LOOPBACK"  # "1" in loopback rank threads (hvd.loopback.world)
 LOOPBACK_TIMEOUT = "LOOPBACK_TIMEOUT"  # s per loopback collective rendezvous
+METRICS = "METRICS"  # unified metrics registry (0 = hot instruments off)
+METRICS_PORT = "METRICS_PORT"  # base port for the per-worker /metrics server
+STRAGGLER_THRESHOLD = "STRAGGLER_THRESHOLD"  # s of submit lag naming a rank a straggler
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -119,19 +122,20 @@ def set_override(name: str, value) -> None:
         return  # no-op re-apply (every autotune sample re-applies the
         # whole state) must not bump the epoch and flush dispatch plans
     _overrides[name] = value
-    _override_epoch += 1
+    # epoch, not telemetry: keys dispatch-plan invalidation
+    _override_epoch += 1  # hvdlint: disable=metrics-registry
 
 
 def clear_override(name: str) -> None:
     global _override_epoch
     if _overrides.pop(name, None) is not None:
-        _override_epoch += 1
+        _override_epoch += 1  # hvdlint: disable=metrics-registry
 
 
 def clear_overrides() -> None:
     global _override_epoch
     if _overrides:
-        _override_epoch += 1
+        _override_epoch += 1  # hvdlint: disable=metrics-registry
     _overrides.clear()
 
 
@@ -337,6 +341,19 @@ def health_interval_s() -> float:
 
 def health_timeout_s() -> float:
     return get_float(HEALTH_TIMEOUT, DEFAULT_HEALTH_TIMEOUT_S)
+
+
+# Straggler attribution (health.StragglerTracker, docs/metrics.md): a rank
+# whose negotiation frame reaches the KV server this many seconds after
+# the round's first submitter is counted a straggler for that round. 1 s
+# sits far above loopback/LAN submit jitter (single-digit ms) and far
+# below the health timeout — sustained straggling warns long before a
+# rank looks dead.
+DEFAULT_STRAGGLER_THRESHOLD_S = 1.0
+
+
+def straggler_threshold_s() -> float:
+    return get_float(STRAGGLER_THRESHOLD, DEFAULT_STRAGGLER_THRESHOLD_S)
 
 
 def donation_effective(platform: str) -> bool:

@@ -296,6 +296,8 @@ def inject(site: str, *, rank: int | None = None,
                 break
     if fired is None:
         return
+    from .. import metrics as _metrics
+    _metrics.FAULT_FIRES.inc(labels={"site": site})
     if fired.action == "delay":
         time.sleep(fired.delay_s)
         return

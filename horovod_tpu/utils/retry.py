@@ -32,29 +32,45 @@ from . import envs
 from . import invariants as _inv
 from . import logging as hvd_logging
 
-# Through the invariants seam so the hvdsched cooperative scheduler
-# (HVD_SCHED_CHECK) serializes the counter lock and runs the backoff
-# sleeps / poll pacing on the virtual clock (docs/schedule_checker.md).
-_mu = _inv.make_lock("retry.counters.mu")
-_counters: dict[str, dict[str, int]] = {}
+# Counter storage lives in the unified metrics registry
+# (``horovod_tpu/metrics.py``: ``hvd_retry_retries_total`` /
+# ``hvd_retry_giveups_total``, labeled by site, ``always=True`` because
+# they back the ``hvd.health_stats()["retries"]`` API). The registry
+# lock is a plain leaf lock, so the backoff sleeps / poll pacing remain
+# the only retry behavior hvdsched serializes (the sleeps stay on the
+# invariants seam's virtual clock).
+
+
+def _metrics():
+    from .. import metrics
+    return metrics
 
 
 def _note(what: str, kind: str) -> None:
-    with _mu:
-        c = _counters.setdefault(what, {"retries": 0, "giveups": 0})
-        c[kind] += 1
+    m = _metrics()
+    inst = m.RETRY_RETRIES if kind == "retries" else m.RETRY_GIVEUPS
+    inst.inc(labels={"site": what})
 
 
 def stats() -> dict:
     """Per-site ``{"retries": n, "giveups": n}`` counters
-    (``hvd.health_stats()["retries"]``)."""
-    with _mu:
-        return {k: dict(v) for k, v in _counters.items()}
+    (``hvd.health_stats()["retries"]``) — a view over the metrics
+    registry, shape-identical to the pre-registry dict."""
+    m = _metrics()
+    out: dict[str, dict[str, int]] = {}
+    for labelitems, v in m.RETRY_RETRIES.series().items():
+        site = dict(labelitems)["site"]
+        out.setdefault(site, {"retries": 0, "giveups": 0})["retries"] = int(v)
+    for labelitems, v in m.RETRY_GIVEUPS.series().items():
+        site = dict(labelitems)["site"]
+        out.setdefault(site, {"retries": 0, "giveups": 0})["giveups"] = int(v)
+    return out
 
 
 def reset_stats() -> None:
-    with _mu:
-        _counters.clear()
+    m = _metrics()
+    m.RETRY_RETRIES.reset()
+    m.RETRY_GIVEUPS.reset()
 
 
 def _jitter_factor(what: str, attempt: int) -> float:

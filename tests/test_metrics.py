@@ -1,0 +1,326 @@
+"""Unified metrics registry (ISSUE 11, docs/metrics.md): instrument
+semantics, Prometheus/JSON exposition, per-rank loopback isolation, and
+negotiation straggler attribution.
+
+The loopback classes run the REAL negotiation wire format at world=4
+(PR-10 substrate), so per-rank store isolation and the fault-injected
+straggler path are tier-1 facts, not claims.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from backend_markers import loopback_world  # noqa: F401  (fixture)
+from horovod_tpu import _native
+from horovod_tpu import metrics as m
+from horovod_tpu.utils import faults as _faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    m.set_enabled(None)
+    yield
+    m.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# instrument semantics
+# ---------------------------------------------------------------------------
+
+class TestInstruments:
+    def test_counter_inc_and_labels(self):
+        before = m.KV_OPS.value({"op": "testop"})
+        m.KV_OPS.inc(labels={"op": "testop"})
+        m.KV_OPS.inc(3, labels={"op": "testop"})
+        assert m.KV_OPS.value({"op": "testop"}) == before + 4
+
+    def test_label_validation(self):
+        with pytest.raises(ValueError):
+            m.KV_OPS.inc()  # missing required label
+        with pytest.raises(ValueError):
+            m.KV_OPS.inc(labels={"verb": "put"})  # wrong label name
+        with pytest.raises(ValueError):
+            m.FUSION_PENDING_BYTES.set(1, labels={"op": "x"})  # undeclared
+
+    def test_gauge_set_add(self):
+        m.FUSION_PENDING_BYTES.set(10)
+        m.FUSION_PENDING_BYTES.add(5)
+        assert m.FUSION_PENDING_BYTES.value() == 15
+
+    def test_histogram_buckets_sum_count(self):
+        h = m.NEGOTIATION_ROUND_SECONDS
+        labels = {"process_set": "t-hist"}
+        base = h.series().get((("process_set", "t-hist"),))
+        assert base is None
+        h.observe(0.003, labels=labels)
+        h.observe(0.2, labels=labels)
+        h.observe(99.0, labels=labels)  # past the last bound: +Inf only
+        series = h.series()[(("process_set", "t-hist"),)]
+        assert series.count == 3
+        assert abs(series.sum - 99.203) < 1e-9
+        # cumulative bucket counts appear in the exposition
+        text = m.prometheus_text()
+        assert ('hvd_negotiation_round_seconds_bucket'
+                '{le="0.005",process_set="t-hist"} 1') in text
+        assert ('hvd_negotiation_round_seconds_bucket'
+                '{le="+Inf",process_set="t-hist"} 3') in text
+        assert ('hvd_negotiation_round_seconds_count'
+                '{process_set="t-hist"} 3') in text
+
+    def test_duplicate_registration_rejected(self):
+        with pytest.raises(ValueError):
+            m.counter("hvd_kv_ops_total", "dup")
+
+    def test_snapshot_delta(self):
+        a = m.snapshot()
+        m.KV_OPS.inc(2, labels={"op": "snap"})
+        m.NEGOTIATION_ROUND_SECONDS.observe(0.1,
+                                            labels={"process_set": "snap"})
+        d = m.delta(m.snapshot(), a)
+        assert d[("hvd_kv_ops_total", (("op", "snap"),))] == 2
+        assert d[("hvd_negotiation_round_seconds_count",
+                  (("process_set", "snap"),))] == 1
+
+    def test_disabled_gates_hot_instruments_only(self):
+        m.set_enabled(False)
+        try:
+            before_hot = m.KV_OPS.value({"op": "gated"})
+            before_always = m.DISPATCH_MISSES.value()
+            m.KV_OPS.inc(labels={"op": "gated"})
+            m.DISPATCH_MISSES.inc()
+            assert m.KV_OPS.value({"op": "gated"}) == before_hot
+            # always=True instruments back legacy *_stats() APIs and
+            # keep recording (docs/metrics.md overhead contract)
+            assert m.DISPATCH_MISSES.value() == before_always + 1
+        finally:
+            m.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# exposition surfaces
+# ---------------------------------------------------------------------------
+
+class TestExposition:
+    def test_every_instrument_emits_headers(self):
+        text = m.prometheus_text()
+        for name, inst in m.instruments().items():
+            assert f"# HELP {name} " in text
+            assert f"# TYPE {name} {inst.kind}" in text
+
+    def test_dump_is_json_shaped(self):
+        m.KV_OPS.inc(labels={"op": "dumped"})
+        d = hvd.metrics_dump()
+        json.dumps(d)  # must be serializable as-is
+        entry = d["hvd_kv_ops_total"]
+        assert entry["type"] == "counter"
+        assert "op" in entry["labels"]
+        assert any(s["labels"].get("op") == "dumped"
+                   for s in entry["series"])
+
+    def test_standalone_server(self):
+        port = m.serve(0)
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert "# TYPE hvd_kv_ops_total counter" in text
+            # idempotent: a second serve keeps the port
+            assert m.serve(0) == port
+        finally:
+            m.stop_serving()
+
+    def test_kv_server_metrics_route_unsigned(self):
+        from horovod_tpu.runner.http_kv import KVServer, make_secret
+        server = KVServer(secret=make_secret())
+        port = server.start()
+        try:
+            # no HMAC header: the /metrics route must serve anyway
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert "# TYPE hvd_negotiation_rounds_total counter" in text
+            # ...while the KV routes stay signed (403 without a header)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/some/key", timeout=10)
+            assert ei.value.code == 403
+        finally:
+            server.stop()
+
+    def test_prometheus_text_parses(self):
+        """Every sample line is `name{labels} value` with a float value
+        — the same check the ci.sh scrape gate applies."""
+        m.KV_OPS.inc(labels={"op": "parse"})
+        m.NEGOTIATION_SUBMIT_LAG.observe(0.01, labels={"rank": 1})
+        for line in m.prometheus_text().splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name_part, _, value = line.rpartition(" ")
+            float(value)
+            assert name_part.split("{")[0].startswith("hvd_")
+
+
+# ---------------------------------------------------------------------------
+# legacy views stay API-compatible
+# ---------------------------------------------------------------------------
+
+class TestLegacyViews:
+    def test_dispatch_cache_stats_shape(self, hvd):
+        s = hvd.dispatch_cache_stats()
+        assert set(s) == {"enabled", "capacity", "size", "hits",
+                          "hits_by_source", "misses", "invalidations",
+                          "evictions", "negotiation_skips",
+                          "chunked_builds", "step_builds"}
+        assert set(s["hits_by_source"]) >= {"call", "flush", "step"}
+        assert s["hits"] == sum(s["hits_by_source"].values())
+
+    def test_health_stats_shape(self, hvd):
+        s = hvd.health_stats()
+        assert set(s) == {"retries", "faults", "watchdogs"}
+        for site, counts in s["retries"].items():
+            assert set(counts) == {"retries", "giveups"}
+
+    def test_retry_counters_round_trip(self):
+        from horovod_tpu.utils import retry as _retry
+        _retry._note("test.site", "retries")
+        _retry._note("test.site", "giveups")
+        s = _retry.stats()["test.site"]
+        assert s["retries"] >= 1 and s["giveups"] >= 1
+        assert m.RETRY_RETRIES.value({"site": "test.site"}) >= 1
+
+
+# ---------------------------------------------------------------------------
+# loopback: per-rank isolation + the world /metrics scrape
+# ---------------------------------------------------------------------------
+
+pytestmark_native = pytest.mark.skipif(
+    not _native.available(), reason="native engine unavailable")
+
+
+@pytestmark_native
+class TestLoopbackIsolation:
+    def test_per_rank_counters_do_not_bleed(self, loopback_world):
+        """Every rank runs the SAME three collectives (the protocol
+        requires symmetric streams) plus a rank-distinct direct
+        increment; each rank's OWN view must read exactly its own
+        values — never a peer's, never a world aggregate."""
+        n = loopback_world.size
+
+        def body():
+            r = hvd.rank()
+            for i in range(3):
+                h = hvd.allreduce_async(jnp.ones(4), op=hvd.Sum,
+                                        name=f"iso{i}")
+                hvd.synchronize(h)
+            m.KV_OPS.inc(r + 1, labels={"op": "isotest"})
+            d = hvd.metrics_dump()
+            flushed = [
+                s for s in
+                d["hvd_fusion_flushed_tensors_total"]["series"]
+                if s["labels"]["process_set"] == "global"]
+            assert len(flushed) == 1, flushed
+            direct = [s for s in d["hvd_kv_ops_total"]["series"]
+                      if s["labels"]["op"] == "isotest"]
+            assert len(direct) == 1, direct
+            return (r, flushed[0]["value"], direct[0]["value"])
+
+        outs = [o.result for o in loopback_world.run(body)]
+        # 3 flushed tensors each (its own, not 3*world), and the direct
+        # counter reads the rank's own increment only
+        assert sorted(outs) == [(r, 3.0, float(r + 1)) for r in range(n)]
+
+    def test_world_scrape_carries_every_rank(self, loopback_world):
+        n = loopback_world.size
+
+        def body():
+            hvd.allreduce(jnp.ones(2), op=hvd.Sum, name="scrape")
+            return "OK"
+
+        assert all(o.result == "OK" for o in loopback_world.run(body))
+        addr, port = loopback_world.kv_endpoint
+        text = urllib.request.urlopen(
+            f"http://{addr}:{port}/metrics", timeout=10).read().decode()
+        # every instrument's headers are present...
+        for name in m.instruments():
+            assert f"# TYPE {name} " in text, name
+        # ...and every rank reported its negotiation rounds
+        for r in range(n):
+            assert (f'hvd_negotiation_rounds_total'
+                    f'{{process_set="global",rank="{r}"}}') in text
+        # no duplicate series after the rank/reporter injection
+        samples = [ln for ln in text.splitlines()
+                   if ln and not ln.startswith("#")]
+        assert len(samples) == len(set(samples))
+
+
+@pytestmark_native
+class TestStragglerAttribution:
+    def test_delayed_rank_named_on_all_survivors(self):
+        """HVD_FAULT_SPEC delay on rank 2's svc.exchange makes rank 2
+        the named straggler on every survivor: counter labels, tracker
+        stats, and the rate-limited warning all say rank 2; rank 2
+        never blames itself (ISSUE 11 acceptance)."""
+        os.environ["HVD_FAULT_SPEC"] = \
+            "svc.exchange:delay=0.4:rank=2:after=4"
+        _faults.refresh()
+        try:
+            with hvd.loopback.world(
+                    4, extra_env={"HVD_STRAGGLER_THRESHOLD": "0.15"}) as w:
+                def body():
+                    from horovod_tpu import engine_service
+                    for i in range(8):
+                        hvd.allreduce(jnp.ones(4), op=hvd.Sum,
+                                      name=f"lag{i}")
+                    svc = engine_service.get_service()
+                    series = hvd.metrics_dump()[
+                        "hvd_straggler_rounds_total"]["series"]
+                    return (hvd.rank(), series, svc.straggler_stats())
+
+                outs = [o.result for o in w.run(body)]
+        finally:
+            os.environ.pop("HVD_FAULT_SPEC", None)
+            _faults.refresh()
+        # On a share-throttled CI box a survivor's own exchange thread
+        # can occasionally be descheduled past the (deliberately low)
+        # test threshold and pick up a stray straggler round of its own
+        # — so assert rank 2 is present and DOMINANT, not exclusive.
+        total_warnings = 0
+        for rank, series, stats in outs:
+            by_rank = {s["labels"]["rank"]: s["value"] for s in series}
+            # a rank never blames itself (its own lag is unobservable)
+            assert str(rank) not in by_rank, series
+            if rank == 2:
+                continue
+            assert by_rank.get("2", 0) >= 1, series
+            assert by_rank["2"] == max(by_rank.values()), series
+            assert stats["straggler_rounds"].get(2, 0) >= 1
+            total_warnings += stats["warnings"]
+            if stats["last_warning"] is not None:
+                assert "global rank 2" in stats["last_warning"]
+                assert "HVD_STRAGGLER_THRESHOLD" in stats["last_warning"]
+        # the injected ~15 over-threshold rounds make a 3-round streak
+        # (and so at least one warning somewhere) effectively certain
+        assert total_warnings >= 1, outs
+
+    def test_submit_lag_histogram_covers_every_member(self, loopback_world):
+        n = loopback_world.size
+
+        def body():
+            for i in range(3):
+                hvd.allreduce(jnp.ones(2), op=hvd.Sum, name=f"sl{i}")
+            d = hvd.metrics_dump()
+            lag = d["hvd_negotiation_submit_lag_seconds"]["series"]
+            return sorted(s["labels"]["rank"] for s in lag)
+
+        for o in loopback_world.run(body):
+            assert o.result == [str(r) for r in range(n)]

@@ -1,6 +1,6 @@
 """hvdlint: project-invariant static analysis for the horovod_tpu runtime.
 
-Seven AST passes, each encoding a concurrency/determinism invariant that
+Eight AST passes, each encoding a concurrency/determinism invariant that
 a PR introduced and a future regression would break silently (a hang or
 a cross-rank divergence, not a test failure):
 
@@ -26,6 +26,10 @@ rank-divergence  collective submissions (``*_async`` / ``flush_entry`` /
                  ``negotiate_many_submit``) never sit under rank-local
                  control flow — rank comparisons, wall-clock tests, set
                  iteration (the mismatched-collective hang class)
+metrics-registry telemetry flows through the unified metrics registry
+                 (``horovod_tpu/metrics.py``): no ad-hoc module-level
+                 counters/dicts, instrument catalog centralized there,
+                 and the catalog round-trips with docs/metrics.md
 ===============  ============================================================
 
 Run ``python -m tools.hvdlint horovod_tpu`` from the repo root; findings

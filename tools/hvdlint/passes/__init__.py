@@ -7,6 +7,7 @@ from . import (
     issue_lock,
     knob_registry,
     lock_order,
+    metrics_registry,
     rank_divergence,
     silent_except,
     timer_purity,
@@ -22,4 +23,5 @@ PASSES = {
     donation.NAME: donation.run,
     silent_except.NAME: silent_except.run,
     rank_divergence.NAME: rank_divergence.run,
+    metrics_registry.NAME: metrics_registry.run,
 }

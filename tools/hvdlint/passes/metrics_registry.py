@@ -1,0 +1,240 @@
+"""metrics-registry pass: telemetry flows through ``metrics.py`` and the
+instrument catalog round-trips with ``docs/metrics.md``.
+
+Invariant (PR 11, ``horovod_tpu/metrics.py``): the unified metrics
+registry is the ONE telemetry namespace — instruments are declared in
+``metrics.py`` at module level with literal names, recorded from
+anywhere, and exposed through ``/metrics`` / ``hvd.metrics_dump()``. An
+ad-hoc module-level counter is invisible to every exposition surface,
+to the per-rank loopback stores (it silently aggregates across ranks),
+and to the HVD_METRICS overhead gate. The knob-registry pass's pattern,
+applied to telemetry:
+
+1. **no ad-hoc module counters**: a module-level integer mutated with
+   ``global NAME`` + ``NAME += ...`` inside a function is an unregistered
+   counter (epochs/sequence state that genuinely isn't telemetry carries
+   a pragma);
+2. **no ad-hoc dict telemetry**: a module-level dict literal whose
+   entries are incremented inside a function (``D[k] += n`` or
+   ``D[k] = D.get(k, ...) + ...``) is an unregistered labeled counter;
+3. **catalog centralization**: instrument constructors
+   (``metrics.counter/gauge/histogram``) are only legal in
+   ``metrics.py`` — a declaration elsewhere is invisible to the
+   docs round-trip;
+4. **doc round-trip**: the literal instrument names declared in
+   ``metrics.py`` and the ``hvd_*`` names in ``docs/metrics.md`` must
+   match exactly in both directions (histogram series suffixes
+   ``_bucket``/``_sum``/``_count`` are derived, not instruments).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Project, dotted_name
+
+NAME = "metrics-registry"
+
+_CONSTRUCTORS = ("counter", "gauge", "histogram")
+_DOC_REL = "docs/metrics.md"
+_DOC_TOKEN = re.compile(r"\bhvd_[a-z][a-z0-9_]*\b")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _module_int_names(sf) -> set[str]:
+    """Module-level names bound to an integer literal (the ad-hoc
+    counter shape: ``_hits = 0``)."""
+    out: set[str] = set()
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and type(node.value.value) is int):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _module_dict_names(sf) -> set[str]:
+    """Module-level names bound to a dict literal / ``dict(...)`` call."""
+    out: set[str] = set()
+    for node in sf.tree.body:
+        value = node.value if isinstance(node, ast.Assign) else None
+        if value is None:
+            continue
+        is_dict = isinstance(value, (ast.Dict, ast.DictComp)) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "dict")
+        if is_dict:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _check_adhoc_counters(project: Project, metrics_rel: str,
+                          findings: list[Finding]) -> None:
+    for sf in project.files:
+        if sf.rel == metrics_rel:
+            continue
+        int_names = _module_int_names(sf)
+        dict_names = _module_dict_names(sf)
+        if not int_names and not dict_names:
+            continue
+        # collect names declared global anywhere in this module's
+        # functions — module-level ints only count as counters when a
+        # function rebinding them via `global` increments them
+        global_names: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.AugAssign) or not isinstance(
+                    node.op, (ast.Add, ast.Sub)):
+                continue
+            if sf.suppressed(NAME, node.lineno):
+                continue
+            target = node.target
+            if (isinstance(target, ast.Name)
+                    and target.id in int_names
+                    and target.id in global_names):
+                findings.append(Finding(
+                    NAME, sf.rel, node.lineno,
+                    f"module-level counter {target.id!r} mutated as "
+                    "telemetry outside metrics.py: invisible to "
+                    "/metrics, metrics_dump(), and the per-rank "
+                    "loopback stores — register a Counter in "
+                    "horovod_tpu/metrics.py (pragma non-telemetry "
+                    "state: epochs, sequence numbers)"))
+            elif (isinstance(target, ast.Subscript)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id in dict_names):
+                findings.append(Finding(
+                    NAME, sf.rel, node.lineno,
+                    f"module-level dict {target.value.id!r} incremented "
+                    "as telemetry outside metrics.py: this is an "
+                    "unregistered labeled counter — register one in "
+                    "horovod_tpu/metrics.py (pragma non-telemetry "
+                    "state)"))
+        # D[k] = D.get(k, ...) + ... — the setdefault-free increment
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id in dict_names
+                    and isinstance(node.value, ast.BinOp)
+                    and isinstance(node.value.op, ast.Add)):
+                continue
+            if sf.suppressed(NAME, node.lineno):
+                continue
+            dname = node.targets[0].value.id
+            reads_get = any(
+                isinstance(sub, ast.Call)
+                and dotted_name(sub.func) == f"{dname}.get"
+                for sub in ast.walk(node.value))
+            if reads_get:
+                findings.append(Finding(
+                    NAME, sf.rel, node.lineno,
+                    f"module-level dict {dname!r} incremented as "
+                    "telemetry outside metrics.py (D[k] = D.get(k) + n) "
+                    "— register a labeled Counter in "
+                    "horovod_tpu/metrics.py"))
+
+
+def _instrument_call(node: ast.AST) -> tuple[str, str] | None:
+    """``(name, kind)`` of an instrument-constructor call — attribute
+    style (``metrics.counter(...)``) or bare name after a
+    ``from ... import counter`` (``counter(...)``) — else None. ``kind``
+    is the constructor name (counter/gauge/histogram)."""
+    if not (isinstance(node, ast.Call) and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("hvd_")):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _CONSTRUCTORS:
+        return node.args[0].value, func.attr
+    if isinstance(func, ast.Name) and func.id in _CONSTRUCTORS:
+        return node.args[0].value, func.id
+    return None
+
+
+def _check_constructor_sites(project: Project, metrics_rel: str,
+                             findings: list[Finding]) -> None:
+    for sf in project.files:
+        if sf.rel == metrics_rel:
+            continue
+        for node in ast.walk(sf.tree):
+            hit = _instrument_call(node)
+            if hit is None or sf.suppressed(NAME, node.lineno):
+                continue
+            findings.append(Finding(
+                NAME, sf.rel, node.lineno,
+                f"instrument {hit[0]!r} declared outside "
+                "horovod_tpu/metrics.py: the catalog is centralized "
+                "there so docs/metrics.md and the exposition "
+                "completeness gate see every instrument"))
+
+
+def _inventory(project: Project, metrics_rel: str
+               ) -> dict[str, tuple[int, str]]:
+    """Instrument name -> (declaration line, kind), from
+    literal-first-arg constructor calls in metrics.py (module level or
+    not — the catalog convention is module level, but the round-trip
+    should see every registration)."""
+    sf = project.by_rel.get(metrics_rel)
+    inv: dict[str, tuple[int, str]] = {}
+    if sf is None:
+        return inv
+    for node in ast.walk(sf.tree):
+        hit = _instrument_call(node)
+        if hit is not None:
+            inv.setdefault(hit[0], (node.lineno, hit[1]))
+    return inv
+
+
+def _check_doc_roundtrip(project: Project, metrics_rel: str,
+                         inventory: dict[str, int],
+                         findings: list[Finding]) -> None:
+    doc_path = project.root / _DOC_REL
+    if not doc_path.exists():
+        findings.append(Finding(
+            NAME, _DOC_REL, 1,
+            "docs/metrics.md is missing — the instrument catalog must "
+            "be documented"))
+        return
+    doc_names: dict[str, int] = {}
+    for i, line in enumerate(doc_path.read_text().splitlines(), start=1):
+        for m in _DOC_TOKEN.finditer(line):
+            doc_names.setdefault(m.group(0), i)
+    for name, (line, _kind) in sorted(inventory.items()):
+        if name not in doc_names:
+            findings.append(Finding(
+                NAME, metrics_rel, line,
+                f"instrument {name} is registered in metrics.py but "
+                f"undocumented in {_DOC_REL}"))
+    # _bucket/_sum/_count are derived series of HISTOGRAMS only — the
+    # same token hanging off a counter/gauge name is a stale doc entry
+    hist_derived = {f"{n}{s}" for n, (_l, kind) in inventory.items()
+                    if kind == "histogram" for s in _HIST_SUFFIXES}
+    for name, line in sorted(doc_names.items()):
+        if name in inventory or name in hist_derived:
+            continue
+        findings.append(Finding(
+            NAME, _DOC_REL, line,
+            f"{_DOC_REL} documents {name}, which is not registered in "
+            "horovod_tpu/metrics.py (stale entry, or the registration "
+            "is missing)"))
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    metrics_rel = f"{project.package_rel}/metrics.py"
+    _check_adhoc_counters(project, metrics_rel, findings)
+    _check_constructor_sites(project, metrics_rel, findings)
+    _check_doc_roundtrip(project, metrics_rel,
+                         _inventory(project, metrics_rel), findings)
+    return findings
