@@ -120,7 +120,10 @@ from .exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
     PeerFailureError,
+    QosAdmissionError,
 )
+from . import qos
+from .qos import QosClass, qos_stats, set_qos
 from .health import health_stats
 from . import metrics
 from .metrics import metrics_dump
@@ -173,7 +176,8 @@ __all__ = [
     "DistributedOptimizer", "allreduce_gradients_transform", "grad",
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
-    "PeerFailureError", "health_stats", "metrics", "metrics_dump",
+    "PeerFailureError", "QosAdmissionError", "QosClass", "qos",
+    "qos_stats", "set_qos", "health_stats", "metrics", "metrics_dump",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
     "checkpoint", "data", "elastic", "loopback", "parallel",
     "average_metrics",

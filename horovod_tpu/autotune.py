@@ -148,6 +148,15 @@ def _default_tunables() -> list[Tunable]:
         # epoch, which drops cached step plans — a stale capture can
         # never survive a knob change.
         Tunable(envs.STEP_CAPTURE, [0, 1]),
+        # Multi-tenant QoS pacing (qos.py; consumed live per gate pump,
+        # inert with HVD_QOS=0). Defaults first so enabling autotune
+        # changes nothing at sample 0. Safe to tune: quantum/window only
+        # re-pace the gate's DETERMINISTIC grant schedule (decisions
+        # sync through rank 0 like every knob, and both are pure-config
+        # inputs to the grant order, never completion timing).
+        Tunable(envs.QOS_QUANTUM, [envs.DEFAULT_QOS_QUANTUM,
+                                   16 * 1024, 256 * 1024]),
+        Tunable(envs.QOS_WINDOW, [envs.DEFAULT_QOS_WINDOW, 2, 8]),
         Tunable(envs.HIERARCHICAL_ALLREDUCE, [0, 1]),
         # Dispatch-plan/response cache on/off, the reference's cache_enabled
         # tunable (parameter_manager.cc CacheEnabledParameter). Default-on

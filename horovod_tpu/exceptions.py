@@ -43,6 +43,31 @@ class PeerFailureError(HorovodInternalError):
             f"peer rank {rank} failed: {reason}{owed}")
 
 
+class QosAdmissionError(RuntimeError):
+    """An async collective submission was shed at enqueue by its
+    tenant's QoS admission control (``hvd.set_qos(...,
+    policy="shed")`` / ``HVD_QOS_*``; docs/qos.md): the tenant's
+    unacknowledged pending bytes would exceed its quota.
+
+    Raised from the submission's handle (``synchronize()`` /
+    ``result()``) — a shed handle always raises, it never returns data.
+    Deliberately NOT a :class:`HorovodInternalError`: shedding is flow
+    control on a healthy engine, not a peer/communication failure, so
+    elastic mode must not respond by re-forming the world. Serving
+    drivers catch it and retry/downgrade the request.
+    """
+
+    def __init__(self, tenant: str, nbytes: int, pending: int, quota: int):
+        self.tenant = tenant
+        self.nbytes = int(nbytes)
+        self.pending = int(pending)
+        self.quota = int(quota)
+        super().__init__(
+            f"tenant {tenant!r}: submission of {nbytes} B shed by QoS "
+            f"admission control ({pending} B already pending, quota "
+            f"{quota} B)")
+
+
 class HostsUpdatedInterrupt(RuntimeError):
     """Internal interrupt raised when the set of available hosts changed.
 

@@ -411,6 +411,34 @@ PIPELINE_INFLIGHT_DEPTH = gauge(
     "Device-incomplete earlier flushes observed at the last executor "
     "slot admission (docs/pipeline.md overlap semantics).")
 
+# -- multi-tenant QoS (qos.py; docs/qos.md) --------------------------------
+QOS_ADMISSION_WAIT = histogram(
+    "hvd_qos_admission_wait_seconds",
+    "Time a flush batch spent parked in the QoS admission gate (submit "
+    "-> grant), per tenant (process set).",
+    labels=("process_set",))
+QOS_GRANTED_BYTES = counter(
+    "hvd_qos_granted_bytes_total",
+    "Payload bytes granted into the flush executor's slots by the QoS "
+    "arbiter, per tenant.",
+    labels=("process_set",))
+QOS_SLOT_SHARE = gauge(
+    "hvd_qos_slot_share",
+    "Tenant's cumulative share (0-1) of all bytes granted into the "
+    "executor slots — converges to the configured weight ratio under "
+    "saturation.",
+    labels=("process_set",))
+QOS_SHED = counter(
+    "hvd_qos_shed_total",
+    "Async submissions shed at enqueue by a tenant pending-bytes quota "
+    "(policy=shed); the handle raises QosAdmissionError.",
+    labels=("process_set",))
+QOS_QUOTA_BLOCKS = counter(
+    "hvd_qos_quota_blocks_total",
+    "Producer enqueues that blocked on a tenant pending-bytes quota "
+    "(policy=block) until in-flight work settled.",
+    labels=("process_set",))
+
 # -- step capture (ops/step_capture.py) ------------------------------------
 STEP_CAPTURE_PHASE = gauge(
     "hvd_step_capture_phase",

@@ -79,6 +79,19 @@ buffers past ``HVD_PIPELINE_THRESHOLD`` additionally dispatch as
 ``HVD_PIPELINE_CHUNKS`` chunk programs (``collectives._chunk_layout``,
 docs/pipeline.md).
 
+**Multi-tenant QoS** (``HVD_QOS=1``; ``horovod_tpu/qos.py``,
+docs/qos.md): batches route through a strict-priority + deficit-round-
+robin admission gate in front of the executor FIFO instead of being
+appended directly — per-process-set tenants get priority tiers, byte-
+weighted fair shares of the executor slots, and pending-bytes quotas
+(``block`` backpressure at enqueue / ``shed`` with a typed
+``QosAdmissionError`` on the handle). Grant order stays a pure function
+of submission order + static QoS config (window pumps and handle-
+observation releases at rank-deterministic program points; executor-
+demand grants for single-controller batches only), so the composition
+contract above survives tenancy. ``HVD_QOS=0`` (default) keeps this
+whole path byte-for-byte.
+
 Statistics surface through :func:`stats` (exported as
 ``hvd.fusion_stats()``; the ``pipeline`` block carries slot occupancy and
 overlap ratio); the timeline gains ``QUEUE_ENQUEUE``, ``CYCLE_FLUSH``,
@@ -98,6 +111,7 @@ import numpy as np
 
 from .. import autotune as _autotune
 from .. import metrics as _metrics
+from .. import qos as _qos
 from .. import timeline as _timeline
 from ..utils import envs
 from ..utils import faults as _faults
@@ -149,16 +163,24 @@ def _flush_counter(tm: dict, tenant: str, trigger: str):
 
 def _pset_label(pset) -> str:
     """Tenant label for the registry's per-process-set fusion counters
-    (the multi-tenant QoS seam): THE derivation is
-    ``engine_service._set_key`` — one function, so fusion and
-    negotiation instruments can never drift apart on a tenant's label —
-    with the global set's ``"0"`` key spelled ``"global"`` (the engine
-    service applies the same mapping to its ``pset_key``)."""
-    if pset is None or getattr(pset, "is_global", True):
-        return "global"
-    from .. import engine_service as _es
-    key = _es._set_key(pset)
-    return "global" if key == "0" else key
+    AND the QoS class registry: the one derivation lives in
+    ``qos.tenant_label`` (``engine_service._set_key`` with the global
+    set spelled ``"global"``), so fusion counters, negotiation
+    instruments, and QoS classes can never drift apart on a tenant's
+    identity."""
+    return _qos.tenant_label(pset)
+
+
+def _qos_tenant_counter(tenant: str, kind: str):
+    """Bound per-tenant QoS counter (``shed`` / ``blocks``), cached in
+    the same per-tenant series map as the fusion counters."""
+    tm = _tenant_metrics(tenant)
+    c = tm.get("qos_" + kind)
+    if c is None:
+        inst = (_metrics.QOS_SHED if kind == "shed"
+                else _metrics.QOS_QUOTA_BLOCKS)
+        c = tm["qos_" + kind] = inst.bind({"process_set": tenant})
+    return c
 
 
 def enabled() -> bool:
@@ -212,7 +234,8 @@ class _Entry:
 
     __slots__ = ("tensors", "count", "grouped", "nbytes", "names",
                  "requests", "run", "queue_key", "label", "event",
-                 "results", "error", "sigs", "captured")
+                 "results", "error", "sigs", "captured", "qos_tenant",
+                 "qos_acked", "qos_inflight", "qos_epoch")
 
     def __init__(self, tensors, grouped, nbytes, names, requests=(),
                  run=None, label=""):
@@ -232,6 +255,18 @@ class _Entry:
         # None = unplannable entry (opaque/sparse), never capturable
         self.sigs = None
         self.captured = False  # held by a step-capture replay
+        # multi-tenant QoS accounting (docs/qos.md): the entry's tenant
+        # label, whether its unacked bytes were released (synchronize
+        # return), whether it currently charges granted-but-unsettled
+        # bytes (set at executor admission, cleared at settle), and the
+        # scheduler quota epoch it was charged under — abort() bumps
+        # the epoch when it zeroes the accounting, so a stale ack or
+        # settle from a pre-abort entry can never deflate charges made
+        # by post-abort submissions
+        self.qos_tenant = None
+        self.qos_acked = False
+        self.qos_inflight = False
+        self.qos_epoch = 0
 
     @property
     def done(self) -> bool:
@@ -304,6 +339,17 @@ class FusionScheduler:
             "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
             "device_wait_ms": 0.0,
         }
+        # -- multi-tenant QoS state (qos.py; all guarded by _exec_cv) --
+        # admission gate (lazy: created at the first submission with
+        # HVD_QOS=1), per-tenant unacknowledged bytes (enqueue ->
+        # synchronize return; the rank-deterministic shed measure) and
+        # granted-but-unsettled bytes (executor admission -> settle;
+        # the block-policy backpressure measure)
+        self._qos_gate = None
+        self._qos_unacked: dict[str, float] = {}
+        self._qos_inflight: dict[str, float] = {}
+        self._qos_epoch = 0  # bumped by abort(); guards stale releases
+        self._qos_stats = {"shed": {}, "quota_blocks": 0}
         # step capture-and-replay controller (HVD_STEP_CAPTURE;
         # ops/step_capture.py): records the marked step's flush stream,
         # then replays the whole step as one cached program
@@ -323,6 +369,12 @@ class FusionScheduler:
         # False and the entry takes the normal path below).
         if self.capture.offer(key, spec, entry):
             return
+        if _qos.enabled():
+            tenant = _pset_label(spec.pset)
+            entry.qos_tenant = tenant
+            cls = _qos.get_class(tenant)
+            if not self._qos_admit(entry, tenant, cls):
+                return  # shed: the handle raises QosAdmissionError
         if entry.requests:
             # Multi-process entries negotiate the whole flush in ONE
             # negotiate_many batch, whose duplicate-name guard only spans
@@ -341,6 +393,12 @@ class FusionScheduler:
             if clash:
                 self.flush_queue(key, "name-reuse")
             if clash or exec_clash:
+                # A clashing batch may be parked in the QoS admission
+                # gate (names register at drain, before the grant):
+                # force-grant it, or the wait below parks forever.
+                gate = self._qos_gate
+                if gate is not None:
+                    gate.release_names(entry.names)
                 # Wait for the clashing names specifically (not just an
                 # executor quiesce): the earlier flush may still be
                 # between its _mu-side name registration and its batch
@@ -370,11 +428,144 @@ class FusionScheduler:
             _timeline.record_queue_enqueue(name or entry.label)
         self._wake.set()
         if over_pending:
-            # Backpressure: drain everything oldest-first so memory held
-            # by pending wire payloads stays bounded.
-            self.flush_all("backpressure")
+            if _qos.enabled() and entry.qos_tenant is not None and \
+                    _qos.get_class(entry.qos_tenant).quota > 0:
+                # QoS backpressure for a QUOTA'D tenant: drain back
+                # under the cap (LOWEST tier first — the bulk backlog
+                # is what moves out; latency tenants' queues drain at
+                # their own synchronize) WITHOUT the flush_all
+                # gate-release + quiesce — quiescing would block THIS
+                # producer (possibly a latency tenant) on the whole
+                # bulk backlog's execution, the exact inversion QoS
+                # exists to prevent. The producer's memory stays
+                # bounded by its OWN quota instead of by the stall. A
+                # tenant with quota=0 (unlimited) has opted out of
+                # that bound, so it keeps the legacy producer-stalling
+                # flush_all below — otherwise nothing would bound it
+                # at all (docs/qos.md "Interactions").
+                self._drain_queues("backpressure",
+                                   until_under=max_pending_bytes() // 2)
+            else:
+                # Backpressure: drain everything oldest-first so memory
+                # held by pending wire payloads stays bounded.
+                self.flush_all("backpressure")
         elif over_threshold:
             self.flush_queue(key, "threshold")
+
+    # -- QoS admission control (docs/qos.md) -------------------------------
+
+    def _qos_admit(self, entry: _Entry, tenant: str, cls) -> bool:
+        """Per-tenant pending-bytes quota at enqueue. ``shed`` consults
+        the unacknowledged-bytes measure — enqueue minus synchronize
+        returns, both rank-deterministic stream points, so every member
+        rank sheds the identical submissions — and fails the handle
+        with :class:`QosAdmissionError`. ``block`` waits for
+        granted-but-unsettled bytes to drop: work the executor WILL
+        settle without any action from this (blocked) producer, and the
+        wait never mutates the admission gate (a completion-timed grant
+        would desynchronize the cross-rank grant order — the
+        determinism contract's one forbidden move, and the planted
+        priority-inversion shape hvdsched's qos-inversion-demo finds).
+        Admission CHARGES the tenant's unacked bytes in the same
+        critical section as the shed check — a separate check-then-
+        reserve would let two same-tenant producer threads both pass
+        against the same pending value and jointly overshoot the quota.
+        Returns False when the entry was shed."""
+        from ..exceptions import QosAdmissionError
+        if cls.policy == "shed" and cls.quota > 0:
+            with self._exec_cv:
+                pending = self._qos_unacked.get(tenant, 0.0)
+                if pending + entry.nbytes <= cls.quota:
+                    self._qos_unacked[tenant] = pending + entry.nbytes
+                    entry.qos_epoch = self._qos_epoch
+                    return True
+                shed = self._qos_stats["shed"]
+                shed[tenant] = shed.get(tenant, 0) + 1
+            # never charged: a synchronize() on the shed handle must not
+            # deflate the unacked measure (the quota would leak headroom
+            # equal to every shed-then-observed submission's size)
+            entry.qos_acked = True
+            entry.error = QosAdmissionError(tenant, entry.nbytes,
+                                            int(pending), cls.quota)
+            entry.tensors = ()
+            entry.run = None
+            entry.event.set()
+            _qos_tenant_counter(tenant, "shed").inc()
+            _timeline.record_qos("SHED", tenant)
+            return False
+        blocked = False
+        with self._exec_cv:
+            if cls.policy == "block" and cls.quota > 0:
+                while True:
+                    # granted-but-unsettled bytes PLUS parked single-
+                    # controller bytes: both drain via the executor
+                    # (settles and demand pulls) with no action from
+                    # this blocked producer, so the wait cannot
+                    # deadlock — while without the parked component a
+                    # single-controller flood's backlog would sit in
+                    # the gate unbounded, never engaging the quota.
+                    # Parked NEGOTIATED bytes stay excluded (window-
+                    # bounded; grantable only at deterministic points a
+                    # blocked producer never reaches).
+                    pending = self._qos_inflight.get(tenant, 0.0)
+                    if self._qos_gate is not None:
+                        pending += self._qos_gate.sc_parked_bytes_locked(
+                            tenant)
+                    # an entry larger than the quota admits once the
+                    # tenant is fully drained — blocking would wait
+                    # forever
+                    if (pending <= 0.0
+                            or pending + entry.nbytes <= cls.quota):
+                        break
+                    if not blocked:
+                        blocked = True
+                        self._qos_stats["quota_blocks"] += 1
+                    # plain wait: grants (_emit_batch_locked),
+                    # _qos_settle, and abort() all notify _exec_cv
+                    self._exec_cv.wait()
+            self._qos_unacked[tenant] = (
+                self._qos_unacked.get(tenant, 0.0) + entry.nbytes)
+            entry.qos_epoch = self._qos_epoch
+        if blocked:
+            _qos_tenant_counter(tenant, "blocks").inc()
+            _timeline.record_qos("BLOCK", tenant)
+        return True
+
+    def _qos_ack(self, entry: _Entry) -> None:
+        """Release the entry's unacknowledged bytes at a synchronize
+        return (idempotent) — the deterministic retirement point of the
+        shed measure. The acked test-and-set sits under ``_exec_cv``:
+        two threads synchronizing one handle concurrently must not
+        double-release the bytes (the per-op clamp would hide the
+        tenant total undercounting, permanently leaking quota
+        headroom)."""
+        if entry.qos_tenant is None:
+            return
+        with self._exec_cv:
+            if entry.qos_acked:
+                return
+            entry.qos_acked = True
+            if entry.qos_epoch != self._qos_epoch:
+                return  # charged under a world abort() already zeroed
+            t = entry.qos_tenant
+            self._qos_unacked[t] = max(
+                0.0, self._qos_unacked.get(t, 0.0) - entry.nbytes)
+
+    def _qos_settle(self, entries) -> None:
+        """Release granted-but-unsettled bytes once entries settle (the
+        block-policy quota's wait condition)."""
+        charged = [e for e in entries if e.qos_inflight]
+        if not charged:
+            return
+        with self._exec_cv:
+            for e in charged:
+                e.qos_inflight = False
+                if e.qos_epoch != self._qos_epoch:
+                    continue  # abort() already zeroed this charge
+                t = e.qos_tenant
+                self._qos_inflight[t] = max(
+                    0.0, self._qos_inflight.get(t, 0.0) - e.nbytes)
+            self._exec_cv.notify_all()
 
     # -- flushing ----------------------------------------------------------
 
@@ -470,21 +661,62 @@ class FusionScheduler:
         if self.capture.intercept_flush(entry, trigger):
             return
         self.flush_queue(entry.queue_key, trigger)
+        # Handle observation is a rank-deterministic program point: if
+        # the entry's batch is parked in the QoS admission gate, grant
+        # it now (every rank's gate jumps at the same stream point, so
+        # the cross-rank grant order stays identical — docs/qos.md).
+        gate = self._qos_gate
+        if gate is not None:
+            gate.release_entry(entry)
 
-    def flush_all(self, trigger: str) -> None:
-        """Drain every queue in first-enqueue order, then quiesce the
-        pipelined executor (barrier / shutdown / backpressure): callers
-        of flush_all need everything *dispatched* on return — a barrier
-        psum issued before a still-queued flush's programs would break
-        the cross-process program issue order."""
+    def _drain_queues(self, trigger: str, until_under: int | None = None
+                      ) -> None:
+        """Drain pending queues — first-enqueue order, or highest QoS
+        tier first with HVD_QOS=1 (high-priority work negotiates and
+        parks ahead of bulk backlogs; deterministic: the pending set at
+        a drain point is a pure function of the submission stream).
+        ``until_under`` stops once total pending bytes fall to/below it
+        (the QoS backpressure path: drain the MINIMUM that restores the
+        cap, instead of chasing an always-refilling backlog on whatever
+        producer thread — possibly a latency tenant's — happened to
+        cross it); a bounded drain evicts the LOWEST tier first — the
+        bulk backlog is what backpressure exists to move out, and a
+        latency tenant's queue is about to drain at its own synchronize
+        anyway."""
+        qos_on = _qos.enabled()
+        bounded = until_under is not None
         while True:
             with self._mu:
-                key = next(iter(self._queues), None)
+                if bounded and self._pending_bytes <= until_under:
+                    return
+                key = None
+                if qos_on:
+                    best = None
+                    for i, (k, q) in enumerate(self._queues.items()):
+                        tier = _qos.get_class(
+                            _pset_label(q.spec.pset)).priority
+                        rank_key = (tier if bounded else -tier, i)
+                        if best is None or rank_key < best:
+                            best, key = rank_key, k
+                else:
+                    key = next(iter(self._queues), None)
             if key is None:
-                break
+                return
             self.flush_queue(key, trigger)
+
+    def flush_all(self, trigger: str) -> None:
+        """Drain every queue (:meth:`_drain_queues`), then release the
+        QoS admission gate and quiesce the pipelined executor (barrier /
+        shutdown / backpressure): callers of flush_all need everything
+        *dispatched* on return — a barrier psum issued before a
+        still-queued flush's programs would break the cross-process
+        program issue order."""
+        self._drain_queues(trigger)
         # a replay caught mid-stream must dispatch its held prefix too
         self.capture.flush_pending(trigger)
+        gate = self._qos_gate
+        if gate is not None:
+            gate.release_all()
         self.quiesce()
 
     def wait_result(self, entry: _Entry):
@@ -492,6 +724,7 @@ class FusionScheduler:
         wait for its dispatch, re-raise any flush failure."""
         self.flush_entry(entry, "synchronize")
         entry.event.wait()
+        self._qos_ack(entry)
         if entry.error is not None:
             raise entry.error
         return entry.results
@@ -510,14 +743,46 @@ class FusionScheduler:
         # flush_queue, inside the same _mu section that drained them from
         # q.names — THAT registration is the load-bearing one (no window
         # for a reused name to slip through); this method only queues.
+        # With HVD_QOS=1 the batch routes through the admission gate
+        # instead: it parks per tenant and the arbiter grants it into
+        # the executor FIFO (window pump here, demand pull in
+        # _exec_loop, forced release at handle observation).
+        if _qos.enabled():
+            with self._exec_cv:
+                if self._qos_gate is None:
+                    self._qos_gate = _qos.QosGate(
+                        self._exec_cv, self._emit_batch_locked,
+                        on_park=self._ensure_exec_thread_locked)
+                gate = self._qos_gate
+            tenant = _pset_label(batch.spec.pset)
+            gate.submit(batch, tenant, _qos.get_class(tenant))
+            return
         with self._exec_cv:
-            self._exec_q.append(batch)
-            self._pstats["submitted"] += 1
-            if self._exec_thread is None or not self._exec_thread.is_alive():
-                self._exec_stop = False
-                self._exec_thread = _inv.spawn_thread(
-                    self._exec_loop, name="hvd-flush-pipeline")
-            self._exec_cv.notify_all()
+            self._emit_batch_locked(batch)
+
+    def _ensure_exec_thread_locked(self) -> None:
+        """Spawn the executor thread if needed (callers hold
+        ``_exec_cv``). Also the QoS gate's ``on_park`` hook: a parked
+        single-controller batch grants ONLY on executor demand, so the
+        executor must exist the moment the gate holds work."""
+        if self._exec_thread is None or not self._exec_thread.is_alive():
+            self._exec_stop = False
+            self._exec_thread = _inv.spawn_thread(
+                self._exec_loop, name="hvd-flush-pipeline")
+
+    def _emit_batch_locked(self, batch: _Batch) -> None:
+        """Append one batch to the executor FIFO (callers hold
+        ``_exec_cv``) — the executor admission point, where QoS
+        granted-but-unsettled bytes are charged."""
+        for e in batch.entries:
+            if e.qos_tenant is not None:
+                e.qos_inflight = True
+                self._qos_inflight[e.qos_tenant] = (
+                    self._qos_inflight.get(e.qos_tenant, 0.0) + e.nbytes)
+        self._exec_q.append(batch)
+        self._pstats["submitted"] += 1
+        self._ensure_exec_thread_locked()
+        self._exec_cv.notify_all()
 
     def _exec_loop(self) -> None:
         """The dedicated dispatch thread: one batch at a time, in strict
@@ -532,6 +797,14 @@ class FusionScheduler:
                 while not self._exec_q:
                     if self._exec_stop:
                         return
+                    # QoS demand pull: a dry FIFO grants the fair-order
+                    # pick among parked SINGLE-CONTROLLER batches
+                    # (work-conserving priority scheduling; negotiated
+                    # batches only grant at rank-deterministic points —
+                    # docs/qos.md determinism contract)
+                    if (self._qos_gate is not None
+                            and self._qos_gate.demand_pull_locked()):
+                        continue
                     # plain wait, no poll timeout: every producer path
                     # (submit, abort, stop) notifies under _exec_cv, so an
                     # idle pipeline sleeps instead of waking twice a second
@@ -668,15 +941,25 @@ class FusionScheduler:
         """Block until none of ``names`` is tracked as an in-flight svc
         negotiation (name-reuse guard): covers the whole span from the
         drain-side registration through batch execution — including the
-        submission window where the executor queue itself looks idle."""
+        submission window where the executor queue itself looks idle.
+        With QoS on, every wakeup re-attempts the gate release: the
+        clashing batch can PARK only after this waiter's enqueue-side
+        release attempt (names register at drain, before the
+        negotiate-submit round trip that precedes the park), and a
+        parked batch under the arbitration window would otherwise never
+        grant while its only observer sits here."""
         if threading.current_thread() is self._exec_thread:
             return
         names = set(names)
         with self._exec_cv:
             while not self._exec_names.isdisjoint(names):
+                if self._qos_gate is not None:
+                    self._qos_gate.release_names_locked(names)
+                    if self._exec_names.isdisjoint(names):
+                        break
                 # plain wait: every path that removes names (batch
                 # completion, abort, submit failure) notifies under
-                # _exec_cv
+                # _exec_cv — and gate.submit notifies on every park
                 self._exec_cv.wait()
 
     # -- execution ---------------------------------------------------------
@@ -684,12 +967,15 @@ class FusionScheduler:
     def _fail_entries(self, entries: list[_Entry], exc) -> None:
         """Mark every undelivered entry so waiters unblock (the error
         re-raises at synchronize())."""
+        failed = []
         for e in entries:
             if not e.done:
                 e.error = exc
                 e.tensors = ()
                 e.run = None
                 e.event.set()
+                failed.append(e)
+        self._qos_settle(failed)
 
     def _execute(self, spec: _QueueSpec, entries: list[_Entry],
                  ticket=None) -> None:
@@ -760,6 +1046,7 @@ class FusionScheduler:
             # error reaches _fail_entries (which skips done entries)
             for e in settled:
                 e.event.set()
+            self._qos_settle(settled)
             raise
         with self._mu:
             self._stats["dispatches"] += 1
@@ -771,6 +1058,7 @@ class FusionScheduler:
         # after its batch already executed).
         for e in settled:
             e.event.set()
+        self._qos_settle(settled)
 
     def _run_fused_unit(self, spec: _QueueSpec, unit: list[_Entry]) -> list:
         from . import collectives as _coll
@@ -922,10 +1210,27 @@ class FusionScheduler:
         with self._exec_cv:
             batches = list(self._exec_q)
             self._exec_q.clear()
+            if self._qos_gate is not None:
+                # parked batches die with the world too (their
+                # negotiation tickets cancel below, like queued ones)
+                batches.extend(self._qos_gate.drain_locked())
             for b in batches:
                 for e in b.entries:
                     if e.requests:
                         self._exec_names.difference_update(e.names)
+                    e.qos_inflight = False
+            # quota accounting dies with the world: zero it and bump
+            # the epoch in the same critical section. EVERY pre-abort
+            # entry — queued, parked, executor-queued, or already
+            # executed but not yet synchronized — carries the old
+            # epoch, so its late ack/settle is a no-op instead of
+            # deflating charges made by post-abort submissions (the
+            # shed quota would otherwise leak headroom equal to the
+            # pre-abort pending). Then wake any quota-blocked
+            # producers (their entries are failing below).
+            self._qos_epoch += 1
+            self._qos_unacked.clear()
+            self._qos_inflight.clear()
             self._exec_cv.notify_all()
         n = 0
         err = lambda e: RuntimeError(
@@ -977,6 +1282,13 @@ class FusionScheduler:
         capture = self.capture.stats()
         with self._exec_cv:
             executed = self._pstats["executed"]
+            qos = {"enabled": _qos.enabled(),
+                   "shed": dict(self._qos_stats["shed"]),
+                   "quota_blocks": self._qos_stats["quota_blocks"],
+                   "unacked_bytes": dict(self._qos_unacked),
+                   "inflight_bytes": dict(self._qos_inflight)}
+            if self._qos_gate is not None:
+                qos.update(self._qos_gate.stats_locked())
             pipeline = {
                 "enabled": envs.pipeline_enabled(),
                 "max_inflight": envs.max_inflight_flushes(),
@@ -1041,6 +1353,10 @@ class FusionScheduler:
                 "coalesce_ratio": (flushed / dispatches if dispatches
                                    else 0.0),
                 "pipeline": pipeline,
+                # multi-tenant QoS admission counters (docs/qos.md):
+                # per-tenant grants/shares from the gate plus the
+                # scheduler-side shed/quota accounting
+                "qos": qos,
                 # step capture-and-replay lifecycle counters
                 # (docs/step_capture.md). Replayed entries never appear
                 # in dispatches/wire_programs — the per-source plan-hit
@@ -1063,6 +1379,7 @@ class FusionScheduler:
                 "depth_sum": 0, "inflight_peak": 0, "slot_waits": 0,
                 "device_wait_ms": 0.0,
             }
+            self._qos_stats = {"shed": {}, "quota_blocks": 0}
         self.capture.reset_stats()
 
 

@@ -155,6 +155,19 @@ def record_inflight_depth(depth: int) -> None:
                PHASE_INSTANT)
 
 
+QOS_LANE = "qos"
+
+
+def record_qos(event: str, tenant: str) -> None:
+    """Instant ``QOS_<event>.<tenant>`` marker on the ``qos`` lane for
+    admission-gate transitions (``PARK``/``GRANT``/``FORCE``/``SHED``/
+    ``BLOCK``) so a tenant's admission waits — and any shed or
+    quota-blocked submissions — are attributable next to the flush and
+    pipeline lanes (docs/qos.md)."""
+    if _active:
+        record(QOS_LANE, f"QOS_{event}.{tenant}", PHASE_INSTANT)
+
+
 CAPTURE_LANE = "step_capture"
 
 

@@ -74,6 +74,15 @@ LOOPBACK_TIMEOUT = "LOOPBACK_TIMEOUT"  # s per loopback collective rendezvous
 METRICS = "METRICS"  # unified metrics registry (0 = hot instruments off)
 METRICS_PORT = "METRICS_PORT"  # base port for the per-worker /metrics server
 STRAGGLER_THRESHOLD = "STRAGGLER_THRESHOLD"  # s of submit lag naming a rank a straggler
+QOS = "QOS"  # multi-tenant QoS collective engine (0 = legacy single-tenant FIFO)
+QOS_WINDOW = "QOS_WINDOW"  # arbitration window: parked batches before a pump grants
+QOS_QUANTUM = "QOS_QUANTUM"  # DRR quantum bytes credited per weight unit per round
+QOS_STARVE_LIMIT = "QOS_STARVE_LIMIT"  # grants between forced oldest-first grants (0 = off)
+QOS_DEFAULT_PRIORITY = "QOS_DEFAULT_PRIORITY"  # tier for unconfigured tenants
+QOS_DEFAULT_WEIGHT = "QOS_DEFAULT_WEIGHT"  # DRR weight for unconfigured tenants
+QOS_PENDING_QUOTA = "QOS_PENDING_QUOTA"  # default per-tenant pending-bytes quota (0 = unlimited)
+QOS_SHED_POLICY = "QOS_SHED_POLICY"  # quota policy for unconfigured tenants: block | shed
+QOS_CLASSES = "QOS_CLASSES"  # per-tenant class spec string (docs/qos.md grammar)
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -309,8 +318,13 @@ def step_capture_enabled() -> bool:
     marked step's rank-deterministic flush stream once, then replay the
     whole step's collective work as ONE cached jitted program. Off by
     default — the eager per-flush path is the reference behavior; the
-    capture plan invalidates transparently on any stream divergence."""
-    return get_bool(STEP_CAPTURE, False)
+    capture plan invalidates transparently on any stream divergence.
+    Mutually exclusive with the multi-tenant QoS engine: capture assumes
+    ONE repeating single-tenant flush stream, while QoS interleaves
+    tenants' flushes by admission policy — with ``HVD_QOS=1`` capture
+    stays off (the transparent eager path, like any divergence;
+    docs/qos.md)."""
+    return get_bool(STEP_CAPTURE, False) and not qos_enabled()
 
 
 def pipeline_chunking_enabled() -> bool:
@@ -354,6 +368,39 @@ DEFAULT_STRAGGLER_THRESHOLD_S = 1.0
 
 def straggler_threshold_s() -> float:
     return get_float(STRAGGLER_THRESHOLD, DEFAULT_STRAGGLER_THRESHOLD_S)
+
+
+# Multi-tenant QoS defaults (horovod_tpu/qos.py, docs/qos.md). The
+# 4-batch arbitration window keeps the gate's deterministic reordering
+# span small (latency) while letting strict-priority/DRR ordering bite
+# on a backlog; the 64 KiB quantum approximates one small fused flush,
+# so weights translate into byte shares at flush granularity; the
+# 16-grant starvation valve bounds how long strict priority can hold a
+# low-tier batch (deterministic grant-count aging, never wall-clock —
+# wall-clock aging would break the rank-deterministic grant order).
+DEFAULT_QOS_WINDOW = 4
+DEFAULT_QOS_QUANTUM = 64 * 1024
+DEFAULT_QOS_STARVE_LIMIT = 16
+DEFAULT_QOS_WEIGHT = 1.0
+
+
+def qos_enabled() -> bool:
+    """Multi-tenant QoS collective engine (``horovod_tpu/qos.py``): off
+    by default — ``HVD_QOS=0`` keeps the single-tenant FIFO flush
+    pipeline byte-for-byte."""
+    return get_bool(QOS, False)
+
+
+def qos_window() -> int:
+    return get_int(QOS_WINDOW, DEFAULT_QOS_WINDOW)
+
+
+def qos_quantum_bytes() -> int:
+    return get_int(QOS_QUANTUM, DEFAULT_QOS_QUANTUM)
+
+
+def qos_starve_limit() -> int:
+    return get_int(QOS_STARVE_LIMIT, DEFAULT_QOS_STARVE_LIMIT)
 
 
 def donation_effective(platform: str) -> bool:

@@ -684,6 +684,42 @@ class TestRankDivergence:
         assert len(found) == 1
         assert "unordered set" in found[0].message
 
+    def test_trips_on_dynamic_queue_and_tenant_state(self, tmp_path):
+        # ISSUE 12: a collective conditioned on dynamic queue depth or
+        # tenant runtime state (completion-timed values that differ per
+        # rank) is the same mismatched-collective hang class
+        src = """
+            import horovod_tpu as hvd
+
+            def adaptive(h):
+                if hvd.fusion_stats()["pending_bytes"] > 1024:
+                    h.allreduce_async([1.0], name="adaptive")
+
+            def tenant_gated(h):
+                load = hvd.qos_stats()["quota_blocks"]
+                if load > 3:
+                    h.allreduce_async([1.0], name="gated")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 2, msgs
+        assert "dynamic queue/tenant runtime state" in msgs
+
+    def test_static_qos_config_passes(self, tmp_path):
+        # static weights/priorities/quotas are pure config (identical on
+        # every rank by the set_qos contract) — NOT flagged
+        src = """
+            import horovod_tpu as hvd
+            from horovod_tpu import qos
+
+            def class_gated(h, ps):
+                cls = qos.get_class(qos.tenant_label(ps))
+                if cls.priority > 0:
+                    h.allreduce_async([1.0], name="prio")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
     def test_rank_symmetric_conditionals_pass(self, tmp_path):
         # every rank evaluates the same test the same way: no divergence
         src = """

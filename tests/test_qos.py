@@ -1,0 +1,644 @@
+"""Multi-tenant QoS collective engine (ISSUE 12; docs/qos.md).
+
+Four layers, mirroring the subsystem's own structure:
+
+* class registry — ``set_qos`` / ``HVD_QOS_CLASSES`` parsing, defaults,
+  validation;
+* the admission gate in isolation — strict-priority tiers, DRR byte
+  shares, the starvation valve, and grant-order determinism (two gates
+  fed identical streams agree byte-for-byte);
+* scheduler integration — shed handles raise ``QosAdmissionError``
+  (never data), deterministic unacked accounting, block-policy
+  backpressure, stats/metrics surfaces, flush-history + grant-history
+  determinism across schedulers, numerics parity QoS on/off;
+* the loopback world=4 tenant-isolation suite — slot-share convergence
+  to skewed weights, shed parity across member ranks, starved-tenant
+  aging.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import _native
+from horovod_tpu import qos
+from horovod_tpu.exceptions import QosAdmissionError
+from horovod_tpu.ops import fusion_cycle
+from horovod_tpu.utils import invariants as _inv
+
+
+@pytest.fixture(autouse=True)
+def _qos_clean():
+    qos.reset()
+    yield
+    qos.reset()
+    fusion_cycle.reset()
+    os.environ.pop("HVD_QOS", None)
+
+
+def _qos_env(monkeypatch, **extra):
+    monkeypatch.setenv("HVD_QOS", "1")
+    for k, v in extra.items():
+        monkeypatch.setenv(k, str(v))
+
+
+# ---------------------------------------------------------------------------
+# class registry
+# ---------------------------------------------------------------------------
+
+class TestClassRegistry:
+    def test_defaults_and_set_qos_merge(self):
+        cls = qos.get_class("global")
+        assert (cls.priority, cls.weight, cls.quota, cls.policy) == \
+            (0, 1.0, 0, "block")
+        hvd.set_qos(None, priority=2, weight=3.0)
+        cls = qos.get_class("global")
+        assert cls.priority == 2 and cls.weight == 3.0
+        # partial update keeps the other fields
+        hvd.set_qos(None, pending_bytes_quota=4096, policy="shed")
+        cls = qos.get_class("global")
+        assert (cls.priority, cls.weight, cls.quota, cls.policy) == \
+            (2, 3.0, 4096, "shed")
+
+    def test_env_classes_grammar(self, monkeypatch):
+        monkeypatch.setenv(
+            "HVD_QOS_CLASSES",
+            "serve:priority=1,weight=8;bulk:quota=1048576,policy=shed")
+        assert qos.get_class("serve").priority == 1
+        assert qos.get_class("serve").weight == 8.0
+        assert qos.get_class("bulk").quota == 1048576
+        assert qos.get_class("bulk").policy == "shed"
+        # explicit API wins over the env entry
+        qos.configure_label("serve", weight=2.0)
+        assert qos.get_class("serve").weight == 2.0
+
+    def test_env_classes_bad_entries_raise(self, monkeypatch):
+        # a malformed spec is all-or-nothing: it raises on EVERY lookup
+        # (regression: it used to raise once, mark itself parsed, and
+        # silently run with the valid prefix half-applied)
+        monkeypatch.setenv("HVD_QOS_CLASSES",
+                           "serve:priority=1;bulk:frobnicate=1")
+        with pytest.raises(ValueError, match="unknown key"):
+            qos.get_class("serve")
+        with pytest.raises(ValueError, match="unknown key"):
+            qos.get_class("bulk")
+        qos.reset()
+        monkeypatch.setenv("HVD_QOS_CLASSES", ":weight=1")
+        with pytest.raises(ValueError, match="missing tenant label"):
+            qos.get_class("x")
+
+    def test_env_classes_change_replaces_stale_entries(self, monkeypatch):
+        monkeypatch.setenv("HVD_QOS_CLASSES", "7:weight=2")
+        assert qos.get_class("7").weight == 2.0
+        # a CHANGED spec replaces the env-installed entry...
+        monkeypatch.setenv("HVD_QOS_CLASSES", "7:weight=8")
+        assert qos.get_class("7").weight == 8.0
+        # ...a deleted label falls back to defaults...
+        monkeypatch.setenv("HVD_QOS_CLASSES", "other:weight=3")
+        assert qos.get_class("7").weight == 1.0
+        # ...and explicit API registrations survive env changes
+        qos.configure_label("7", weight=5.0)
+        monkeypatch.setenv("HVD_QOS_CLASSES", "7:weight=9")
+        assert qos.get_class("7").weight == 5.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="weight"):
+            qos.QosClass(weight=0.0)
+        with pytest.raises(ValueError, match="policy"):
+            qos.QosClass(policy="drop")
+
+    def test_tenant_label_derivation(self):
+        assert qos.tenant_label(None) == "global"
+        ps = hvd.add_process_set([0, 1])
+        try:
+            assert qos.tenant_label(ps) == str(ps.process_set_id)
+        finally:
+            hvd.remove_process_set(ps)
+
+
+# ---------------------------------------------------------------------------
+# the admission gate in isolation
+# ---------------------------------------------------------------------------
+
+class _Spec:
+    def __init__(self, svc):
+        self.svc = svc
+
+
+class _Ent:
+    def __init__(self, nbytes, name):
+        self.nbytes = nbytes
+        self.names = (name,)
+        self.qos_tenant = None
+        self.qos_inflight = False
+
+
+class _B:
+    """Gate-level fake batch: spec.svc + entries with nbytes/names."""
+
+    def __init__(self, nbytes, name="b", svc=True):
+        self.spec = _Spec(object() if svc else None)
+        self.entries = [_Ent(nbytes, name)]
+
+
+def _gate(emitted):
+    cv = _inv.make_condition("test.qos.gate")
+    return qos.QosGate(cv, lambda b: emitted.append(b))
+
+
+class TestGate:
+    def test_drr_byte_shares_within_tier(self, monkeypatch):
+        # quantum = batch size: grants interleave 3:1 by weight
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=64, HVD_QOS_QUANTUM=100,
+                 HVD_QOS_STARVE_LIMIT=0)
+        qos.configure_label("A", weight=3.0)
+        qos.configure_label("B", weight=1.0)
+        emitted = []
+        g = _gate(emitted)
+        for i in range(8):
+            g.submit(_B(100, f"a{i}"), "A", qos.get_class("A"))
+            g.submit(_B(100, f"b{i}"), "B", qos.get_class("B"))
+        g.release_all()
+        order = [t for t, _ in g.grant_history]
+        assert order[:8] == ["A", "A", "A", "B", "A", "A", "A", "B"], order
+        st = g.stats_locked()
+        assert st["tenants"]["A"]["granted_bytes"] == 800
+        assert st["tenants"]["B"]["granted_bytes"] == 800  # all drained
+
+    def test_strict_priority_tiers(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=64, HVD_QOS_QUANTUM=100,
+                 HVD_QOS_STARVE_LIMIT=0)
+        qos.configure_label("lo", priority=0, weight=10.0)
+        qos.configure_label("hi", priority=1, weight=1.0)
+        emitted = []
+        g = _gate(emitted)
+        for i in range(3):
+            g.submit(_B(100, f"lo{i}"), "lo", qos.get_class("lo"))
+        for i in range(3):
+            g.submit(_B(100, f"hi{i}"), "hi", qos.get_class("hi"))
+        g.release_all()
+        order = [t for t, _ in g.grant_history]
+        # the later-submitted higher tier is served entirely first
+        assert order == ["hi"] * 3 + ["lo"] * 3, order
+
+    def test_starvation_valve_serves_oldest(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=64, HVD_QOS_QUANTUM=100,
+                 HVD_QOS_STARVE_LIMIT=3)
+        qos.configure_label("lo", priority=0)
+        qos.configure_label("hi", priority=1)
+        emitted = []
+        g = _gate(emitted)
+        g.submit(_B(100, "lo0"), "lo", qos.get_class("lo"))
+        for i in range(8):
+            g.submit(_B(100, f"hi{i}"), "hi", qos.get_class("hi"))
+        g.release_all()
+        order = [t for t, _ in g.grant_history]
+        # strict priority alone would starve "lo" to the end; the valve
+        # forces the globally oldest batch every 3rd grant
+        assert order.index("lo") == 3, order
+        assert g.stats_locked()["starve_grants"] >= 1
+
+    def test_window_pump_holds_svc_backlog(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=2, HVD_QOS_QUANTUM=1000,
+                 HVD_QOS_STARVE_LIMIT=0)
+        emitted = []
+        g = _gate(emitted)
+        for i in range(5):
+            g.submit(_B(100, f"s{i}"), "T", qos.get_class("T"))
+        # pump keeps at most window=2 svc batches parked
+        assert len(emitted) == 3
+        with g._cv:
+            assert g.parked_depth_locked() == 2
+        g.release_all()
+        assert len(emitted) == 5
+
+    def test_single_controller_waits_for_demand(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=2, HVD_QOS_QUANTUM=1000)
+        emitted = []
+        g = _gate(emitted)
+        for i in range(5):
+            g.submit(_B(100, f"s{i}", svc=False), "T", qos.get_class("T"))
+        assert emitted == []  # no window pump for single-controller
+        with g._cv:
+            # the block-quota component: parked sc bytes are tracked...
+            assert g.sc_parked_bytes_locked("T") == 500.0
+            assert g.demand_pull_locked() is True
+            # ...and released per grant
+            assert g.sc_parked_bytes_locked("T") == 400.0
+        assert len(emitted) == 1
+        g.release_all()
+        assert len(emitted) == 5
+        with g._cv:
+            assert g.sc_parked_bytes_locked("T") == 0.0
+
+    def test_grant_order_deterministic_across_gates(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=3, HVD_QOS_QUANTUM=128,
+                 HVD_QOS_STARVE_LIMIT=4)
+        qos.configure_label("A", priority=1, weight=2.0)
+        qos.configure_label("B", priority=0, weight=1.0)
+        qos.configure_label("C", priority=1, weight=1.0)
+
+        def run_stream():
+            emitted = []
+            g = _gate(emitted)
+            for i in range(6):
+                tenant = ("A", "B", "C")[i % 3]
+                g.submit(_B(64 * (1 + i % 2), f"{tenant}{i}"), tenant,
+                         qos.get_class(tenant))
+            g.release_all()
+            return list(g.grant_history)
+
+        assert run_stream() == run_stream()
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration (single-controller opaque entries)
+# ---------------------------------------------------------------------------
+
+class _Pset:
+    is_global = False
+
+    def __init__(self, pid):
+        self.process_set_id = pid
+
+
+def _opaque(name, nbytes, run=None, delay=0.0):
+    def _run():
+        if delay:
+            time.sleep(delay)
+        return name
+    return fusion_cycle._Entry([None], False, nbytes, [name],
+                               run=run or _run, label=name)
+
+
+def _spec(pset, svc=None):
+    return fusion_cycle._QueueSpec("sparse", pset, None, svc=svc)
+
+
+class TestSchedulerIntegration:
+    def test_shed_handle_raises_never_returns_data(self, monkeypatch):
+        _qos_env(monkeypatch)
+        qos.configure_label("7", pending_bytes_quota=100, policy="shed")
+        sched = fusion_cycle.FusionScheduler()
+        ps = _Pset(7)
+        e1 = _opaque("s1", 60)
+        e2 = _opaque("s2", 60)  # 60 + 60 > 100: deterministic shed
+        sched.enqueue(("sparse", "k"), _spec(ps), e1)
+        sched.enqueue(("sparse", "k"), _spec(ps), e2)
+        assert e2.done and isinstance(e2.error, QosAdmissionError)
+        assert e2.results is None and e2.tensors == ()
+        with pytest.raises(QosAdmissionError, match="shed"):
+            sched.wait_result(e2)
+        # regression (code review): synchronizing the SHED handle must
+        # not deflate the unacked measure — e2 was never charged, so
+        # the tenant's pending stays exactly e1's 60 bytes and a
+        # would-be-over-quota submission still sheds
+        assert sched.stats()["qos"]["unacked_bytes"]["7"] == 60.0
+        e2b = _opaque("s2b", 60)
+        sched.enqueue(("sparse", "k"), _spec(ps), e2b)
+        assert isinstance(e2b.error, QosAdmissionError)
+        assert sched.wait_result(e1) == ["s1"]
+        # synchronize acked e1's bytes: the next submission readmits
+        e3 = _opaque("s3", 60)
+        sched.enqueue(("sparse", "k"), _spec(ps), e3)
+        assert not isinstance(e3.error, QosAdmissionError)
+        assert sched.wait_result(e3) == ["s3"]
+        st = sched.stats()["qos"]
+        assert st["shed"] == {"7": 2}
+        sched.stop()
+
+    def test_oversized_entry_sheds_deterministically(self, monkeypatch):
+        _qos_env(monkeypatch)
+        qos.configure_label("7", pending_bytes_quota=100, policy="shed")
+        sched = fusion_cycle.FusionScheduler()
+        e = _opaque("big", 1000)
+        sched.enqueue(("sparse", "k"), _spec(_Pset(7)), e)
+        assert isinstance(e.error, QosAdmissionError)
+        sched.stop()
+
+    def test_block_policy_waits_on_inflight_then_admits(self, monkeypatch):
+        _qos_env(monkeypatch)
+        qos.configure_label("7", pending_bytes_quota=150, policy="block")
+        sched = fusion_cycle.FusionScheduler()
+        ps = _Pset(7)
+        e1 = _opaque("b1", 100, delay=0.3)
+        sched.enqueue(("sparse", "k"), _spec(ps), e1)
+        sched.flush_queue(("sparse", "k"), "threshold")
+        # wait for the executor's demand pull to grant e1 (charging the
+        # tenant's in-flight bytes) — it then executes for ~0.3 s; e2
+        # over the quota must block until e1 settles, then admit
+        deadline = time.monotonic() + 10.0
+        while (sched.stats()["qos"]["inflight_bytes"].get("7", 0) < 100
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        e2 = _opaque("b2", 100)
+        sched.enqueue(("sparse", "k2"), _spec(ps), e2)
+        blocked_for = time.monotonic() - t0
+        assert sched.stats()["qos"]["quota_blocks"] >= 1
+        assert blocked_for > 0.05, blocked_for
+        assert sched.wait_result(e1) == ["b1"]
+        assert sched.wait_result(e2) == ["b2"]
+        sched.stop()
+
+    def test_flush_and_grant_history_deterministic(self, monkeypatch):
+        """ISSUE 12 acceptance: two schedulers fed identical streams
+        produce byte-identical flush histories AND grant histories with
+        QoS enabled (svc-marked batches: every grant point is a
+        deterministic program point)."""
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=2, HVD_QOS_QUANTUM=64,
+                 HVD_QOS_STARVE_LIMIT=3)
+        qos.configure_label("7", priority=1, weight=2.0)
+        qos.configure_label("8", priority=0, weight=1.0)
+
+        def run_stream():
+            sched = fusion_cycle.FusionScheduler()
+            svc = object()  # svc-marked: sparse batches never consult it
+            psets = {7: _Pset(7), 8: _Pset(8)}
+            for i in range(8):
+                pid = 7 if i % 3 != 0 else 8
+                e = _opaque(f"t{pid}.{i}", 48 + 16 * (i % 2))
+                sched.enqueue(("sparse", f"k{pid}"), _spec(
+                    psets[pid], svc=svc), e)
+                sched.flush_queue(("sparse", f"k{pid}"), "threshold")
+            sched.flush_all("barrier")
+            flushes = list(sched.flush_history)
+            grants = list(sched._qos_gate.grant_history)
+            sched.stop()
+            return flushes, grants
+
+        assert run_stream() == run_stream()
+
+    def test_abort_fails_parked_batches(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=64)
+        sched = fusion_cycle.FusionScheduler()
+        svc = object()
+        entries = []
+        for i in range(4):
+            e = _opaque(f"p{i}", 32)
+            entries.append(e)
+            sched.enqueue(("sparse", f"k{i}"), _spec(_Pset(7), svc=svc), e)
+            sched.flush_queue(("sparse", f"k{i}"), "threshold")
+        # svc batches under the window stay parked; abort must fail them
+        n = sched.abort("test reset")
+        assert n >= 1
+        for e in entries:
+            assert e.done
+            if e.error is not None:
+                assert "aborted" in str(e.error)
+        st = sched.stats()["qos"]
+        assert st["unacked_bytes"] == {} and st["inflight_bytes"] == {}
+        sched.stop()
+
+    def test_abort_acks_dead_entries(self, monkeypatch):
+        """Regression (code review): synchronizing a handle that died
+        in abort() must not deflate unacked bytes charged by POST-abort
+        submissions (the shed quota would leak pre-abort headroom)."""
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=64)
+        qos.configure_label("7", pending_bytes_quota=1000, policy="shed")
+        sched = fusion_cycle.FusionScheduler()
+        svc = object()
+        e1 = _opaque("pre", 100)
+        sched.enqueue(("sparse", "k"), _spec(_Pset(8), svc=svc), e1)
+        sched.flush_queue(("sparse", "k"), "threshold")  # parks (svc)
+        # plus the subtler population: an entry that already EXECUTED
+        # pre-abort but was never synchronized (it lives in no queue,
+        # gate, or executor batch at abort time); its tenant is the
+        # quota'd one so the late ack targets the post-abort charge
+        ps = _Pset(7)
+        e0 = _opaque("done-pre", 100)
+        sched.enqueue(("sparse", "k0"), _spec(ps), e0)
+        sched.flush_queue(("sparse", "k0"), "threshold")
+        assert e0.event.wait(10.0) and e0.error is None  # executed
+        sched.abort("test reset")
+        e2 = _opaque("post", 100)
+        sched.enqueue(("sparse", "k2"), _spec(ps), e2)
+        assert sched.stats()["qos"]["unacked_bytes"]["7"] == 100.0
+        with pytest.raises(RuntimeError, match="aborted"):
+            sched.wait_result(e1)
+        assert sched.wait_result(e0) == ["done-pre"]
+        # neither late observation released e2's live charge
+        assert sched.stats()["qos"]["unacked_bytes"]["7"] == 100.0
+        assert sched.wait_result(e2) == ["post"]
+        sched.stop()
+
+    def test_starved_tenant_completes_under_flood(self, monkeypatch):
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=2, HVD_QOS_QUANTUM=64,
+                 HVD_QOS_STARVE_LIMIT=4)
+        qos.configure_label("9", priority=5, weight=8.0)
+        qos.configure_label("3", priority=0, weight=1.0)
+        sched = fusion_cycle.FusionScheduler()
+        svc = object()
+        lo = _opaque("lo", 32)
+        sched.enqueue(("sparse", "klo"), _spec(_Pset(3), svc=svc), lo)
+        sched.flush_queue(("sparse", "klo"), "threshold")
+        for i in range(12):
+            e = _opaque(f"hi{i}", 32)
+            sched.enqueue(("sparse", f"khi{i}"),
+                          _spec(_Pset(9), svc=svc), e)
+            sched.flush_queue(("sparse", f"khi{i}"), "threshold")
+        grants = [t for t, _ in sched._qos_gate.grant_history]
+        # the valve granted the starved tier-0 batch mid-flood, not last
+        assert "3" in grants, grants
+        assert grants.index("3") <= 2 * 4, grants
+        sched.flush_all("barrier")
+        assert sched.wait_result(lo) == ["lo"]
+        sched.stop()
+
+    def test_qos_off_is_inert(self):
+        assert not qos.enabled()
+        sched = fusion_cycle.FusionScheduler()
+        e = _opaque("x", 64)
+        sched.enqueue(("sparse", "k"), _spec(None), e)
+        assert e.qos_tenant is None
+        assert sched.wait_result(e) == ["x"]
+        assert sched._qos_gate is None
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end eager collectives (real dispatch, 8-chip CPU mesh)
+# ---------------------------------------------------------------------------
+
+class TestEagerQos:
+    def test_numerics_parity_qos_on_off(self, hvd, monkeypatch):
+        tensors = [hvd.per_rank(
+            [jnp.full((16,), float((r + 1) * (i + 1))) for r in
+             range(hvd.size())]) for i in range(6)]
+
+        def run_round():
+            hs = [hvd.allreduce_async(t, op=hvd.Sum) for t in tensors]
+            return [np.asarray(hvd.synchronize(h)) for h in hs]
+
+        base = run_round()
+        _qos_env(monkeypatch, HVD_QOS_WINDOW=2)
+        hvd.set_qos(None, priority=1, weight=2.0)
+        fusion_cycle.reset()
+        on = run_round()
+        for a, b in zip(base, on):
+            assert a.tobytes() == b.tobytes()
+
+    def test_qos_metrics_series_live(self, hvd, monkeypatch):
+        from horovod_tpu import metrics as m
+        _qos_env(monkeypatch)
+        hvd.set_qos(None, weight=2.0)
+        fusion_cycle.reset()
+        h = hvd.allreduce_async(jnp.ones(8), op=hvd.Sum)
+        hvd.synchronize(h)
+        text = m.prometheus_text()
+        assert "hvd_qos_granted_bytes_total{" in text
+        assert "hvd_qos_slot_share{" in text
+        assert "hvd_qos_admission_wait_seconds_count{" in text
+        stats = hvd.qos_stats()
+        assert stats["enabled"] is True
+        assert "global" in stats["classes"]
+        assert stats["tenants"]["global"]["granted_bytes"] > 0
+
+    def test_shed_on_real_async_handle(self, hvd, monkeypatch):
+        _qos_env(monkeypatch)
+        hvd.set_qos(None, pending_bytes_quota=64, policy="shed")
+        fusion_cycle.reset()
+        h = hvd.allreduce_async(jnp.ones(128), op=hvd.Sum)  # 512 B > 64
+        with pytest.raises(QosAdmissionError):
+            hvd.synchronize(h)
+
+
+# ---------------------------------------------------------------------------
+# loopback world=4 tenant isolation (the ISSUE 12 satellite suite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not _native.available(),
+                    reason="native engine unavailable")
+class TestLoopbackTenantIsolation:
+    QOS_ENV = {
+        "HVD_QOS": "1",
+        "HVD_DYNAMIC_PROCESS_SETS": "1",
+        # every 1 KiB submission threshold-flushes its own batch, so the
+        # admission gate sees a stream of batches to arbitrate
+        "HVD_FUSION_THRESHOLD": "512",
+        "HVD_QOS_QUANTUM": "1024",
+        "HVD_QOS_STARVE_LIMIT": "0",
+    }
+
+    def test_slot_share_converges_to_weights_world4(self):
+        """Two tenants with 4:1 weights submit equal demand from ranks
+        0/1; the window holds half the backlog, so the granted half's
+        byte share converges to the weight ratio — read off
+        hvd_qos_slot_share in each member rank's world."""
+        n_bursts = 24
+        env = dict(self.QOS_ENV)
+        env["HVD_QOS_WINDOW"] = str(n_bursts)  # half of the 2x backlog
+        with hvd.loopback.world(4, extra_env=env) as w:
+            def body():
+                from horovod_tpu import metrics as m
+                r = hvd.rank()
+                ps = hvd.add_process_set([0, 1])
+                hvd.set_qos(ps, weight=4.0)
+                hvd.set_qos(None, weight=1.0)
+                handles = []
+                for i in range(n_bursts):
+                    if r < 2:
+                        handles.append(hvd.allreduce_async(
+                            jnp.full((256,), float(r + i)), op=hvd.Sum,
+                            process_set=ps, name=f"a{i}"))
+                    handles.append(hvd.allreduce_async(
+                        jnp.full((256,), float(r + i)), op=hvd.Sum,
+                        name=f"g{i}"))
+                share = None
+                if r < 2:
+                    label = str(ps.process_set_id)
+                    share = m.QOS_SLOT_SHARE.value(
+                        labels={"process_set": label}, default=None)
+                outs = [np.asarray(hvd.synchronize(h)) for h in handles]
+                ok = all(np.isfinite(o).all() for o in outs)
+                return share, ok
+
+            results = [o.result for o in w.run(body, timeout=240)]
+        for r, (share, ok) in enumerate(results):
+            assert ok, f"rank {r} got bad numerics"
+            if r < 2:
+                # weights 4:1 over equal demand: the granted half is
+                # ~80% tenant-A bytes (tolerance for the window edge)
+                assert share is not None, f"rank {r}: no share series"
+                assert 0.6 <= share <= 0.95, (r, share)
+
+    def test_shed_parity_world4(self):
+        """Shed decisions ride the rank-deterministic unacked measure:
+        every member rank sheds the IDENTICAL submissions, shed handles
+        raise (never return wrong data), and the surviving entries'
+        numerics are correct."""
+        env = dict(self.QOS_ENV)
+        with hvd.loopback.world(4, extra_env=env) as w:
+            def body():
+                r = hvd.rank()
+                ps = hvd.add_process_set([0, 1])
+                # quota fits exactly two 1 KiB submissions
+                hvd.set_qos(ps, pending_bytes_quota=2048, policy="shed")
+                outcome = []
+                if r < 2:
+                    hs = [hvd.allreduce_async(
+                              jnp.full((256,), float(i + 1)), op=hvd.Sum,
+                              process_set=ps, name=f"s{i}")
+                          for i in range(4)]  # 3rd and 4th shed
+                    for h in hs:
+                        try:
+                            out = np.asarray(hvd.synchronize(h))
+                            outcome.append(("ok", float(out[0])))
+                        except QosAdmissionError:
+                            outcome.append(("shed", None))
+                    shed = hvd.fusion_stats()["qos"]["shed"]
+                    outcome.append(("count", shed.get(
+                        str(ps.process_set_id), 0)))
+                return outcome
+
+            results = [o.result for o in w.run(body, timeout=240)]
+        member0, member1 = results[0], results[1]
+        assert member0 == member1, (member0, member1)
+        kinds = [k for k, _ in member0[:4]]
+        assert kinds == ["ok", "ok", "shed", "shed"], member0
+        # sum over both members of full((256,), i+1): 2 * (i+1)
+        assert member0[0][1] == 2.0 and member0[1][1] == 4.0, member0
+        assert member0[4] == ("count", 2), member0
+
+    def test_starved_tenant_aging_bounded_world4(self):
+        """A tier-0 tenant's oldest parked flush must not age without
+        bound under a tier-1 flood: the starvation valve grants it
+        within HVD_QOS_STARVE_LIMIT grants."""
+        env = dict(self.QOS_ENV)
+        env["HVD_QOS_STARVE_LIMIT"] = "4"
+        env["HVD_QOS_WINDOW"] = "2"
+        with hvd.loopback.world(4, extra_env=env) as w:
+            def body():
+                r = hvd.rank()
+                ps = hvd.add_process_set([0, 1])
+                hvd.set_qos(ps, priority=1, weight=4.0)
+                hvd.set_qos(None, priority=0, weight=1.0)
+                handles = []
+                # one low-tier (global) submission, then a high-tier
+                # flood from the subset tenant
+                handles.append(hvd.allreduce_async(
+                    jnp.ones(256), op=hvd.Sum, name="lo"))
+                if r < 2:
+                    for i in range(12):
+                        handles.append(hvd.allreduce_async(
+                            jnp.ones(256), op=hvd.Sum, process_set=ps,
+                            name=f"hi{i}"))
+                sched = fusion_cycle.scheduler()
+                grants = [t for t, _ in sched._qos_gate.grant_history] \
+                    if sched._qos_gate is not None else []
+                for h in handles:
+                    hvd.synchronize(h)
+                return grants
+
+            results = [o.result for o in w.run(body, timeout=240)]
+        for r in (0, 1):
+            grants = results[r]
+            assert "global" in grants, (r, grants)
+            # the valve bounds the low-tier batch's age in grants
+            assert grants.index("global") <= 8, (r, grants)

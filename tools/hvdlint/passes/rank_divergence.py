@@ -14,10 +14,17 @@ stall inspector names it after 60 s; the job is already dead).
 *Checked:* call sites of the submission surface — any ``*_async`` call,
 ``flush_entry``, or ``negotiate_many_submit`` — lexically inside the
 body/orelse of an ``if``/``while``/ternary whose test is **rank-local**
-(contains a rank-family or wall-clock call, or a local name assigned
-from one), or inside a ``for`` over an obvious ``set`` value (unordered
-iteration diverges submission *order* across ranks even when the call
-count matches).
+(contains a rank-family or wall-clock call, a **dynamic queue/tenant
+runtime-state** read — ``fusion_stats()`` / ``qos_stats()`` /
+``dispatch_cache_stats()`` / ``health_stats()`` / ``metrics_dump()``,
+whose values track per-rank completion timing, so a collective
+conditioned on them is the same mismatched-collective hang class as a
+rank-conditioned one — or a local name assigned from one), or inside a
+``for`` over an obvious ``set`` value (unordered iteration diverges
+submission *order* across ranks even when the call count matches).
+Static QoS *configuration* reads (``qos.get_class`` /
+``set_qos`` weights, priorities, quotas) stay legal: they are pure
+config, identical on every rank by the set_qos contract.
 
 Rank-symmetric conditionals are fine and common (``root_rank``
 dispatch where every rank takes the same branch is NOT flagged — the
@@ -43,6 +50,15 @@ _WALLCLOCK = {"time.time", "time.time_ns", "time.monotonic",
 # (utils/invariants.monotonic and its _inv/primitives aliases): matched
 # by last segment, since the package never spells time.monotonic raw
 _WALLCLOCK_LAST = {"monotonic", "perf_counter"}
+# dynamic queue/tenant runtime state (ISSUE 12): these read per-rank
+# scheduler/engine progress — queue depths, shed counts, in-flight
+# bytes, cache hit rates — which track completion timing and therefore
+# differ across ranks. A collective submission conditioned on them is
+# the mismatched-collective hang class; static QoS config (weights,
+# priorities, quotas via qos.get_class/set_qos) is NOT in this set.
+_RUNTIME_STATE_LAST = {"fusion_stats", "qos_stats",
+                       "dispatch_cache_stats", "health_stats",
+                       "metrics_dump", "straggler_stats"}
 _SUBMIT_NAMES = {"flush_entry", "negotiate_many_submit"}
 
 
@@ -58,6 +74,8 @@ def _taint_call(node: ast.AST) -> str | None:
         return f"{name}()"
     if name in _WALLCLOCK or last in _WALLCLOCK_LAST:
         return f"{name}() (wall clock)"
+    if last in _RUNTIME_STATE_LAST:
+        return f"{name}() (dynamic queue/tenant runtime state)"
     return None
 
 

@@ -38,12 +38,12 @@ def _inv():
     return invariants
 
 
-def _opaque(fc, name, value=None, fail=None):
+def _opaque(fc, name, value=None, fail=None, nbytes=0):
     def run():
         if fail is not None:
             raise fail
         return value if value is not None else name
-    return fc._Entry([None], False, 0, [name], run=run, label=name)
+    return fc._Entry([None], False, nbytes, [name], run=run, label=name)
 
 
 def _sparse_spec(fc):
@@ -405,6 +405,85 @@ def pr6_unguarded():
     _starvation_model(guarded=False)
 
 
+class _FakePset:
+    """Minimal process-set stand-in for QoS tenancy models: carries the
+    two attributes ``qos.tenant_label`` reads (no runtime init needed,
+    so the model stays pure-Python under exploration)."""
+
+    is_global = False
+
+    def __init__(self, pid: int):
+        self.process_set_id = pid
+
+
+def qos_admission():
+    """Multi-tenant QoS clean matrix (ISSUE 12): two tenants' producers
+    enqueue + threshold-flush through the admission gate — skewed
+    weights, tenant 8 behind a shed quota — while an ``abort()`` races
+    the quota accounting, the window pump, the executor demand pull,
+    and ``flush_all``'s gate release. Contract: every entry settles
+    with a result, a deterministic :class:`QosAdmissionError` (shed),
+    or the abort error — no waiter hangs, no parked batch is lost in
+    the gate across the abort."""
+    import os
+
+    from horovod_tpu import qos
+    from horovod_tpu.exceptions import QosAdmissionError
+    inv, fc = _inv(), _fusion()
+    prev = {k: os.environ.get(k)
+            for k in ("HVD_QOS", "HVD_QOS_WINDOW", "HVD_QOS_QUANTUM")}
+    os.environ["HVD_QOS"] = "1"
+    os.environ["HVD_QOS_WINDOW"] = "1"
+    os.environ["HVD_QOS_QUANTUM"] = "64"
+    qos.reset()
+    try:
+        qos.configure_label("7", priority=1, weight=4.0)
+        qos.configure_label("8", weight=1.0, pending_bytes_quota=96,
+                            policy="shed")
+        sched = fc.FusionScheduler()
+        psets = {7: _FakePset(7), 8: _FakePset(8)}
+        entries: list = []
+
+        def producer(pid):
+            spec = fc._QueueSpec("sparse", psets[pid], None, svc=None)
+            for j in range(3):
+                e = _opaque(fc, f"t{pid}.{j}", value=(pid, j), nbytes=48)
+                entries.append(e)
+                sched.enqueue(("sparse", f"k{pid}"), spec, e)
+                sched.flush_queue(("sparse", f"k{pid}"), "threshold")
+
+        def aborter():
+            sched.abort("chaos: simulated service reset")
+
+        ts = [inv.spawn_thread(producer, name="tenant-7", args=(7,)),
+              inv.spawn_thread(producer, name="tenant-8", args=(8,)),
+              inv.spawn_thread(aborter, name="aborter")]
+        for t in ts:
+            inv.join_thread(t)
+        sched.flush_all("shutdown")
+        _assert_settled(entries)
+        for e in entries:
+            if e.error is None:
+                continue
+            if not isinstance(e.error, (QosAdmissionError, RuntimeError)):
+                raise AssertionError(
+                    f"entry {e.label!r} failed with unexpected "
+                    f"{e.error!r}")
+        # a shed entry must NEVER carry results (raises, not wrong data)
+        for e in entries:
+            if isinstance(e.error, QosAdmissionError) and e.results:
+                raise AssertionError(
+                    f"shed entry {e.label!r} carries results {e.results!r}")
+        sched.stop()
+    finally:
+        qos.reset()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def loopback_exchange():
     """The loopback world's negotiation-round rendezvous (ISSUE 10): N
     rank tasks race submit/exchange/deliver on the shared
@@ -540,12 +619,52 @@ def lost_wakeup_demo():
         inv.join_thread(t)
 
 
+def qos_inversion_demo():
+    """PLANTED priority-inversion deadlock (ISSUE 12): a low-priority
+    batch holds the last executor slot while a high-priority submission
+    waits behind a quota-blocked enqueue. The BUG is the shape the real
+    block-policy quota avoids by construction (``_qos_admit``: an
+    atomic check-and-wait on granted-but-unsettled bytes the executor
+    settles on its own): here the quota check reads the slot state
+    OUTSIDE the condition's atomic check-and-wait, so a schedule where
+    the low-priority batch frees the slot between the check and the
+    wait loses the notify — the high-priority enqueue parks forever
+    while the executor waits for a grant only that producer can make.
+    Most schedules pass; exploration must FIND the window and the
+    finding replays byte-for-byte from (seed, trace)."""
+    inv = _inv()
+    cv = inv.make_condition("qosdemo.cv")
+    state = {"slot_busy": True, "granted": []}
+
+    def executor():
+        with cv:
+            state["slot_busy"] = False  # the low-prio batch completes
+            cv.notify_all()
+            while not state["granted"]:  # serve the next grant
+                cv.wait()
+
+    def high_prio_producer():
+        # BUG: quota check and wait are not atomic — the inversion
+        if state["slot_busy"]:
+            with cv:
+                cv.wait()
+        with cv:
+            state["granted"].append("high")
+            cv.notify_all()
+
+    ts = [inv.spawn_thread(executor, name="executor"),
+          inv.spawn_thread(high_prio_producer, name="producer-high")]
+    for t in ts:
+        inv.join_thread(t)
+
+
 MATRIX = {
     "enqueue-flush": enqueue_flush_quiesce,
     "flush-abort": flush_abort_race,
     "quiesce-race": quiesce_enqueue_race,
     "watchdog-abort": watchdog_poison_abort,
     "capture-replay-abort": capture_replay_abort,
+    "qos-admission": qos_admission,
     "loopback-exchange": loopback_exchange,
     "pr3-issue-lock": pr3_issue_lock,
     "pr6-chain-guard": pr6_chain_guard,
@@ -555,6 +674,7 @@ DEMOS = {
     "deadlock-demo": deadlock_demo,
     "lost-wakeup-demo": lost_wakeup_demo,
     "loopback-exchange-unguarded": loopback_exchange_unguarded,
+    "qos-inversion-demo": qos_inversion_demo,
     "pr3-unguarded": pr3_unguarded,
     "pr6-unguarded": pr6_unguarded,
 }
