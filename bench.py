@@ -1180,6 +1180,218 @@ def run_capture_bench(args) -> None:
     }))
 
 
+def run_protocol_child(args) -> None:
+    """One world of the protocol-scalability sweep, in a FRESH process
+    whose XLA_FLAGS seeded exactly ``--protocol-child`` virtual devices
+    (the parent sets that; one interpreter cannot re-initialize the CPU
+    backend at three device counts). Boots a loopback world, runs
+    warm-up + steady-state negotiated steps, and prints ONE JSON line of
+    per-rank registry deltas: KV ops, busy negotiation rounds, round
+    latency, response-cache hits/misses (docs/negotiation.md)."""
+    import jax.numpy as jnp  # noqa: F811 - local for clarity
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics as _hvd_metrics
+
+    n = args.protocol_child
+    cached = str(args.protocol_cache).strip().lower() not in (
+        "0", "false", "no", "off", "")
+    extra = {
+        "HVD_RESPONSE_CACHE": "1" if cached else "0",
+        "HVD_HIER_NEGOTIATION": "auto" if args.protocol_hier == "auto"
+        else args.protocol_hier,
+        # Many rank threads time-slicing a 2-core CI box can starve a
+        # watchdog thread past the 30 s production default (compile
+        # storms; the flat lane's 16-way gather pressure) — that is CPU
+        # starvation of the emulation, not a protocol death; give the
+        # bench worlds a budget that scales with world size
+        "HVD_HEALTH_TIMEOUT": str(max(60, 2 * n)),
+    }
+    tensors = args.protocol_tensors
+    warmup, steady = args.protocol_warmup, args.protocol_steps
+
+    def _delta_sum(delta, name):
+        return sum(v for (nm, _labels), v in delta.items() if nm == name)
+
+    def body():
+        r = hvd.rank()
+
+        def one_step(step):
+            outs = []
+            for i in range(tensors):
+                outs.append(hvd.allreduce(
+                    jnp.full((16,), float(r + 1), jnp.float32),
+                    op=hvd.Sum, name=f"pb{i}"))
+            return outs
+
+        expect = float(sum(range(1, n + 1)))
+        ok = True
+        for s in range(warmup):
+            outs = one_step(s)
+            ok = ok and all(np.allclose(np.asarray(o), expect)
+                            for o in outs)
+        s0 = _hvd_metrics.snapshot()
+        t0 = time.perf_counter()
+        for s in range(steady):
+            outs = one_step(warmup + s)
+        wall = time.perf_counter() - t0
+        s1 = _hvd_metrics.snapshot()
+        ok = ok and all(np.allclose(np.asarray(o), expect) for o in outs)
+        d = _hvd_metrics.delta(s1, s0)
+        from horovod_tpu import engine_service
+        svc = engine_service.get_service()
+        return {
+            "ok": bool(ok),
+            "transport": type(svc.transport).__name__,
+            "kv_ops": _delta_sum(d, "hvd_kv_ops_total"),
+            "rounds": _delta_sum(d, "hvd_negotiation_rounds_total"),
+            "round_s_sum": _delta_sum(d, "hvd_negotiation_round_seconds_sum"),
+            "round_s_count": _delta_sum(
+                d, "hvd_negotiation_round_seconds_count"),
+            "rc_hits": _delta_sum(d, "hvd_response_cache_hits_total"),
+            "rc_misses": _delta_sum(d, "hvd_response_cache_misses_total"),
+            "steady_wall_s": wall,
+        }
+
+    with hvd.loopback.world(n, extra_env=extra) as w:
+        per_rank = [o.result for o in w.run(body)]
+
+    capture_parity = None
+    if args.protocol_capture_parity:
+        # ISSUE-13 acceptance: the world also completes capture-on/off
+        # parity training steps (PR-8 negotiate_step replay at scale).
+        def parity_world(capture):
+            env = dict(extra, HVD_STEP_CAPTURE="1" if capture else "0")
+            with hvd.loopback.world(n, extra_env=env) as w2:
+                def pbody():
+                    r = hvd.rank()
+                    vals = []
+                    for step in range(3):
+                        hvd.step_marker()
+                        hs = [hvd.allreduce_async(
+                                  jnp.full((4,), float(r + i + step)),
+                                  op=hvd.Sum, name=f"cp{i}")
+                              for i in range(2)]
+                        vals.append([np.asarray(h.result()).tobytes()
+                                     for h in hs])
+                    hvd.step_marker()
+                    return vals
+                return [o.result for o in w2.run(pbody)]
+        on, off = parity_world(True), parity_world(False)
+        capture_parity = bool(all(a == b for a, b in zip(on, off)))
+
+    steps = steady * max(1, len(per_rank))
+    kv_per_rank_step = [p["kv_ops"] / steady for p in per_rank]
+    rounds = sum(p["rounds"] for p in per_rank)
+    round_sum = sum(p["round_s_sum"] for p in per_rank)
+    round_count = sum(p["round_s_count"] for p in per_rank)
+    hits = sum(p["rc_hits"] for p in per_rank)
+    misses = sum(p["rc_misses"] for p in per_rank)
+    print(json.dumps({
+        "world": n,
+        "cached": cached,
+        "transport": per_rank[0]["transport"],
+        "numerics_match": all(p["ok"] for p in per_rank),
+        "steady_steps": steady,
+        "tensors_per_step": tensors,
+        # per-rank KV ops per steady step: the curve the ci gate reads
+        "kv_ops_per_rank_step_mean": round(
+            float(np.mean(kv_per_rank_step)), 3),
+        "kv_ops_per_rank_step_max": round(
+            float(np.max(kv_per_rank_step)), 3),
+        "busy_rounds_per_rank_step": round(
+            rounds / (steps or 1), 4),
+        "round_latency_ms_mean": round(
+            (round_sum / round_count * 1e3) if round_count else 0.0, 3),
+        "cache_hit_rate": round(hits / (hits + misses), 4)
+        if (hits + misses) else None,
+        "steady_ms_per_step": round(float(np.median(
+            [p["steady_wall_s"] for p in per_rank])) / steady * 1e3, 2),
+        "capture_parity": capture_parity,
+    }), flush=True)
+
+
+def run_protocol_bench(args) -> None:
+    """Protocol-scalability sweep (ROADMAP; ISSUE 13 — BENCH_r13):
+    negotiation round latency, per-rank KV ops/step, and response-cache
+    hit rate vs world ∈ --protocol-worlds, each world in a FRESH
+    subprocess with its own virtual-device count, in two modes: today's
+    flat uncached protocol vs hierarchy + coordinator ResponseCache.
+    Prints ONE JSON line; ``value`` is the cached-mode per-rank KV
+    ops/step growth factor from the smallest to the largest world —
+    ≈1.0 means steady-state control-plane cost is independent of world
+    size (ci.sh gates this plus the flat-mode latency-growth bound)."""
+    worlds = sorted({int(w) for w in args.protocol_worlds.split(",") if w})
+    results: dict = {}
+    skipped_flat: list = []
+    for world in worlds:
+        for mode, (cache, hier) in (("flat", ("0", "0")),
+                                    ("cached", ("1", "auto"))):
+            if mode == "flat" and world > args.protocol_flat_max:
+                # no silent caps: flat rounds grow superlinearly on the
+                # CPU emulation (world=16 already measures ~0.8 s/round
+                # here); world=64 flat would run for hours. The cached
+                # lane still covers it; the skip is recorded.
+                skipped_flat.append(world)
+                print(f"protocol-bench: skipping flat mode at world="
+                      f"{world} (> --protocol-flat-max="
+                      f"{args.protocol_flat_max})", file=sys.stderr)
+                continue
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={world}")
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--protocol-child", str(world),
+                   "--protocol-cache", cache,
+                   "--protocol-hier", hier,
+                   "--protocol-steps", str(args.protocol_steps),
+                   "--protocol-warmup", str(args.protocol_warmup),
+                   "--protocol-tensors", str(args.protocol_tensors)]
+            if cache == "1" and world >= 64:
+                cmd.append("--protocol-capture-parity")
+            proc = subprocess.run(
+                cmd, env=env, capture_output=True, text=True,
+                timeout=1800, cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"protocol child world={world} mode={mode} failed:\n"
+                    f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            results.setdefault(str(world), {})[mode] = payload
+    lo, hi = str(worlds[0]), str(worlds[-1])
+    cached_lo = results[lo]["cached"]["kv_ops_per_rank_step_mean"]
+    cached_hi = results[hi]["cached"]["kv_ops_per_rank_step_mean"]
+    # growth of steady-state per-rank control-plane traffic with world;
+    # both sides are idle-heartbeat-only when the cache serves (busy
+    # rounds are zero), so a tiny denominator means "already flat"
+    kv_growth = (cached_hi / cached_lo) if cached_lo else 0.0
+    flat_lat = {w: results[w]["flat"]["round_latency_ms_mean"]
+                for w in results if "flat" in results[w]}
+    hit_rates = {w: results[w]["cached"]["cache_hit_rate"]
+                 for w in results}
+    print(json.dumps({
+        "metric": "protocol_scalability",
+        "value": round(kv_growth, 3) if kv_growth is not None else None,
+        "unit": f"cached per-rank KV-ops/step growth world {lo} -> {hi} "
+                "(1.0 = flat in world)",
+        "numerics_match": all(
+            results[w][m]["numerics_match"]
+            for w in results for m in results[w]),
+        "worlds": results,
+        "cache_hit_rate_by_world": hit_rates,
+        "flat_round_latency_ms_by_world": flat_lat,
+        "baseline": "flat KVTransport with HVD_RESPONSE_CACHE=0 at each "
+                    "world (today's protocol)",
+        "flat_mode_skipped_at": skipped_flat,
+        "config": {"steps": args.protocol_steps,
+                   "warmup": args.protocol_warmup,
+                   "tensors_per_step": args.protocol_tensors,
+                   "worlds": worlds,
+                   "flat_max": args.protocol_flat_max},
+    }))
+
+
 def _pctl(samples, q):
     return float(np.percentile(np.asarray(samples), q)) * 1e3
 
@@ -1646,6 +1858,39 @@ def main():
     parser.add_argument("--metrics-size", type=int, default=4096,
                         help="bytes per tensor in --metrics-bench (small: "
                              "maximizes per-dispatch overhead visibility)")
+    parser.add_argument("--protocol-bench", action="store_true",
+                        help="protocol-scalability sweep: negotiation "
+                             "round latency + per-rank KV ops/step + "
+                             "response-cache hit rate vs world, flat vs "
+                             "hierarchy+cache (BENCH_r13; "
+                             "docs/negotiation.md)")
+    parser.add_argument("--protocol-worlds", default="4,16,64",
+                        help="comma-separated loopback world sizes to "
+                             "sweep (each in a fresh subprocess)")
+    parser.add_argument("--protocol-child", type=int, default=0,
+                        help="(internal) run ONE world of the sweep in "
+                             "this process; XLA devices must already be "
+                             "seeded by the parent")
+    parser.add_argument("--protocol-cache", default="0",
+                        help="(internal) HVD_RESPONSE_CACHE for the child")
+    parser.add_argument("--protocol-hier", default="auto",
+                        help="(internal) HVD_HIER_NEGOTIATION for the "
+                             "child")
+    parser.add_argument("--protocol-steps", type=int, default=8,
+                        help="steady-state steps measured per world")
+    parser.add_argument("--protocol-warmup", type=int, default=3,
+                        help="warm-up steps before the measured window "
+                             "(negotiate + confirm the response cache)")
+    parser.add_argument("--protocol-tensors", type=int, default=4,
+                        help="named negotiated allreduces per step")
+    parser.add_argument("--protocol-flat-max", type=int, default=16,
+                        help="largest world the FLAT (uncached) lane "
+                             "runs at — its rounds grow superlinearly "
+                             "on the CPU emulation; larger worlds run "
+                             "the cached lane only (skip is recorded)")
+    parser.add_argument("--protocol-capture-parity", action="store_true",
+                        help="(internal) also run capture-on/off parity "
+                             "steps in the child world")
     parser.add_argument("--serve-bench", action="store_true",
                         help="run the multi-tenant inference-serving QoS "
                              "benchmark (CPU backend, no accelerator "
@@ -1706,6 +1951,10 @@ def main():
         return run_capture_bench(args)
     if args.metrics_bench:
         return run_metrics_bench(args)
+    if args.protocol_child:
+        return run_protocol_child(args)
+    if args.protocol_bench:
+        return run_protocol_bench(args)
     if args.serve_bench:
         return run_serve_bench(args)
 

@@ -125,6 +125,7 @@ from .exceptions import (
 from . import qos
 from .qos import QosClass, qos_stats, set_qos
 from .health import health_stats
+from .engine_service import response_cache_stats
 from . import metrics
 from .metrics import metrics_dump
 from .timeline import start_timeline, stop_timeline
@@ -177,7 +178,8 @@ __all__ = [
     "value_and_grad", "broadcast_optimizer_state", "broadcast_parameters",
     "broadcast_variables", "HorovodInternalError", "HostsUpdatedInterrupt",
     "PeerFailureError", "QosAdmissionError", "QosClass", "qos",
-    "qos_stats", "set_qos", "health_stats", "metrics", "metrics_dump",
+    "qos_stats", "set_qos", "health_stats", "response_cache_stats",
+    "metrics", "metrics_dump",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
     "checkpoint", "data", "elastic", "loopback", "parallel",
     "average_metrics",

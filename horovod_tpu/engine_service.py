@@ -30,6 +30,7 @@ from . import health as _health
 from . import metrics as _metrics
 from . import timeline as _timeline
 from .loopback import context as _lbctx
+from .negotiation import response_cache as _rcache
 from .utils import invariants as _inv
 from .dynamic import (
     HorovodCollectiveError,
@@ -139,12 +140,17 @@ class NegotiationTicket:
     it. Exactly one of :meth:`DynamicService.negotiate_many_wait` /
     :meth:`DynamicService.negotiate_many_cancel` must consume a ticket."""
 
-    __slots__ = ("requests", "pends", "submitted_at")
+    __slots__ = ("requests", "pends", "submitted_at", "served")
 
-    def __init__(self, requests, pends):
+    def __init__(self, requests, pends, served: bool = False):
         self.requests = requests
         self.pends = pends
         self.submitted_at = time.monotonic()
+        # True when the whole batch was answered by the coordinator
+        # ResponseCache (docs/negotiation.md): the pends are already
+        # satisfied, no engine/KV work is in flight, and the wait path
+        # must not re-feed the cache with its own output.
+        self.served = served
 
 
 class DynamicService:
@@ -157,14 +163,42 @@ class DynamicService:
         self.engine = engine
         self.transport = transport
         self.pset_key = pset_key  # metrics process_set label
+        # Idle-cadence default scales with world size: every member's
+        # cycle thread exchanges every tick (the rounds are lockstep),
+        # so a 64-rank world at the 20 ms small-world cadence would put
+        # ~6400 idle HTTP ops/s on the one coordinator KV server. The
+        # scaled default bounds idle fleet load at O(world/idle_cycle);
+        # the PENDING floor is untouched, so busy rounds still tick
+        # fast, and worlds <= 16 keep today's cadence byte-for-byte.
+        world = getattr(transport, "world_size", 1)
+        self._idle_cycle_default_ms = (
+            DEFAULT_KV_CYCLE_TIME_MS * max(1.0, world / 16.0))
         # With no explicit value the knob is re-read every cycle so the
         # autotuner's CYCLE_TIME override takes effect live (the reference's
         # ParameterManager adjusts cycle time mid-run the same way).
         self._cycle_time_from_knob = cycle_time_s is None
         if cycle_time_s is None:
             cycle_time_s = envs.get_float(
-                envs.CYCLE_TIME, DEFAULT_KV_CYCLE_TIME_MS) / 1000.0
+                envs.CYCLE_TIME, self._idle_cycle_default_ms) / 1000.0
         self.cycle_time_s = cycle_time_s
+        # Coordinator ResponseCache (docs/negotiation.md): steady-state
+        # batches whose responses are confirmed globally coherent are
+        # answered locally with zero KV rounds. Off by default
+        # (HVD_RESPONSE_CACHE); invalidated on knob-override epoch,
+        # coordinated abort, and service stop/reset (which is how
+        # process-set changes and elastic re-forms reach it — a new
+        # world builds new services).
+        cap = envs.response_cache_capacity()
+        self._rcache = (_rcache.ResponseCache(cap, pset_key)
+                        if cap > 0 else None)
+        self._rc_epoch = envs.override_epoch()
+        # Latched once any JOIN is observed: a joined rank only learns
+        # of scheduled collectives (for its zero executions) from real
+        # rounds, and a peer's locally-served uneven tail would starve
+        # it forever — see docs/negotiation.md "Joins". Joins cluster at
+        # end-of-training/elastic drains, so the lost steady-state wins
+        # after one are noise.
+        self._rc_join_latch = False
         self._cycle = 0
         self._mu = threading.Lock()
         self._pending: dict[str, _Pending] = {}
@@ -195,7 +229,11 @@ class DynamicService:
                 # Per-set services run on transport-local indices; the
                 # watchdog reports failures in GLOBAL process ranks so
                 # the elastic driver blacklists the right host.
-                global_ranks=global_ranks)
+                global_ranks=global_ranks,
+                # Hierarchical transports share their group layout so
+                # beats aggregate leader-side and the monitor reads
+                # O(G + world/G) keys per tick instead of O(world).
+                layout=getattr(transport, "group_layout", None))
             self._watchdog.start()
         # Straggler attribution over the transport's per-round submit
         # lags (health.StragglerTracker, docs/metrics.md): counted and
@@ -243,6 +281,7 @@ class DynamicService:
         whole point of join); stall warnings still fire for visibility."""
         from .dynamic import REQ_JOIN
         self._joined = True
+        self._rc_join_latch = True  # see __init__: joins end local serving
         try:
             resp = self.negotiate(name, REQ_JOIN,
                                   timeout=timeout if timeout is not None
@@ -277,6 +316,9 @@ class DynamicService:
         round proceeds on the cycle thread; the returned ticket must be
         consumed by ``negotiate_many_wait`` or ``negotiate_many_cancel``."""
         _faults.inject("svc.submit")
+        served = self._try_serve_cached(requests)
+        if served is not None:
+            return served
         pends = []
         with self._mu:
             # Failure check under the SAME lock that inserts the pends:
@@ -375,15 +417,21 @@ class DynamicService:
             for req in requests:
                 _timeline.record(req["name"], _timeline.NEGOTIATE,
                                  _timeline.PHASE_END)
-            with self._mu:
-                for req, pend in zip(requests, pends):
-                    self._pending.pop(req["name"], None)
-                    # On timeout, also abandon undelivered members in the
-                    # native engine so the name can be retried (otherwise
-                    # it sits in outstanding_ forever and any reuse raises
-                    # DuplicateNameError with no recovery path).
-                    if timed_out and pend.response is None:
-                        self.engine.abandon(req["name"])
+            if not ticket.served:
+                # A cache-served ticket never registered its names: the
+                # pop would orphan a CONCURRENT real negotiation of the
+                # same name (its delivery would find no pend and its
+                # waiter would block out the full exchange deadline).
+                with self._mu:
+                    for req, pend in zip(requests, pends):
+                        self._pending.pop(req["name"], None)
+                        # On timeout, also abandon undelivered members in
+                        # the native engine so the name can be retried
+                        # (otherwise it sits in outstanding_ forever and
+                        # any reuse raises DuplicateNameError with no
+                        # recovery path).
+                        if timed_out and pend.response is None:
+                            self.engine.abandon(req["name"])
         out = []
         for req, pend in zip(requests, pends):
             resp = pend.response
@@ -394,6 +442,14 @@ class DynamicService:
                     f"negotiation of {req['name']!r} aborted")
             if resp.is_error:
                 raise HorovodCollectiveError(resp.error_message)
+            if self._rcache is not None and not ticket.served:
+                # Feed the coordinator cache from real rounds only. A
+                # from_cache response CONFIRMS the entry: the AND-ed
+                # cache bit vector proved every rank held it that cycle
+                # and delivered it at the same negotiation index, so
+                # every rank flips to local serving deterministically
+                # at the same occurrence (docs/negotiation.md).
+                self._rcache.note_response(req, resp)
             out.append(resp)
         return out
 
@@ -406,6 +462,8 @@ class DynamicService:
         for req in ticket.requests:
             _timeline.record(req["name"], _timeline.NEGOTIATE,
                              _timeline.PHASE_END)
+        if ticket.served:
+            return  # nothing registered, nothing in the engine to drop
         with self._mu:
             for req, pend in zip(ticket.requests, ticket.pends):
                 self._pending.pop(req["name"], None)
@@ -432,7 +490,69 @@ class DynamicService:
     def health_watchdog(self) -> _health.HealthWatchdog | None:
         return self._watchdog
 
+    def response_cache_stats(self) -> dict | None:
+        """This service's coordinator ResponseCache view, or None when
+        ``HVD_RESPONSE_CACHE`` is off."""
+        return self._rcache.stats() if self._rcache is not None else None
+
     # -- internals ---------------------------------------------------------
+
+    def _try_serve_cached(self, requests) -> NegotiationTicket | None:
+        """Answer the whole batch from the coordinator ResponseCache —
+        or None to take the full negotiation path. All-or-nothing per
+        batch: a mixed batch keeps its one-round semantics. Serving
+        requires every entry confirmed globally coherent (see
+        ``negotiation/response_cache.py``), still present in the NATIVE
+        cache (stream-driven invalidation: every rank stops serving on
+        the cycle a peer's changed-metadata request lands), and no JOIN
+        in flight (a joined rank only learns of scheduled collectives
+        from real rounds — serving locally would starve its zero
+        executions)."""
+        rc = self._rcache
+        if rc is None or not requests:
+            return None
+        epoch = envs.override_epoch()
+        if epoch != self._rc_epoch:
+            # knob-override epoch: tuned knobs change wire composition
+            # exactly like the dispatch plan cache's flush
+            self._rc_epoch = epoch
+            rc.invalidate("knob override epoch")
+        if (self._rc_join_latch or self._joined
+                or self.engine.join_pending()):
+            self._rc_join_latch = True
+            return None
+        responses = []
+        for req in requests:
+            resp = rc.lookup_confirmed(req)
+            if resp is None or not self.engine.cache_has(req["name"]):
+                rc.count_missed(sum(
+                    1 for r in requests if _rcache.cacheable(r)))
+                return None
+            responses.append(resp)
+        with self._mu:
+            if self._failure:
+                raise self._failure_error()
+            for req in requests:
+                # Same deterministic duplicate-name contract as the full
+                # path: a name still registered by an in-flight REAL
+                # negotiation must raise here, not be served — and the
+                # served ticket must never touch that registration.
+                if req["name"] in self._pending:
+                    from .dynamic import DuplicateNameError
+                    raise DuplicateNameError(
+                        f"tensor name {req['name']!r} is already being "
+                        "negotiated; pass a unique name=")
+        pends = []
+        for resp in responses:
+            pend = _Pending()
+            pend.response = resp
+            pend.event.set()
+            pends.append(pend)
+        rc.count_served(len(requests))
+        for req in requests:
+            _timeline.record(req["name"], _timeline.NEGOTIATE,
+                             _timeline.PHASE_BEGIN)
+        return NegotiationTicket(requests, pends, served=True)
 
     def _failure_error(self) -> Exception:
         return (self._failure_exc
@@ -449,6 +569,10 @@ class DynamicService:
             self._failure = message
             pend = list(self._pending.values())
             self._pending.clear()
+        if self._rcache is not None:
+            # coordinated abort / stop: whatever world comes next (an
+            # elastic re-form, a fresh service) must re-prove coherence
+            self._rcache.invalidate(message)
         for p in pend:
             p.event.set()
 
@@ -494,7 +618,7 @@ class DynamicService:
                 return
             if self._cycle_time_from_knob:
                 self.cycle_time_s = envs.get_float(
-                    envs.CYCLE_TIME, DEFAULT_KV_CYCLE_TIME_MS) / 1000.0
+                    envs.CYCLE_TIME, self._idle_cycle_default_ms) / 1000.0
             cycle_s = self.cycle_time_s
             adaptive = envs.get_bool(envs.ADAPTIVE_CYCLE, True)
             if adaptive:
@@ -724,8 +848,21 @@ def get_service(pset=None) -> DynamicService | None:
             prefix = "engine/{}:{}/ps{}".format(
                 envs.get(envs.COORDINATOR_ADDR, "local"),
                 envs.get(envs.COORDINATOR_PORT, "0"), key)
-            transport = KVTransport(kv, len(member_procs),
-                                    member_procs.index(me), prefix=prefix)
+            # Control-plane topology (docs/negotiation.md): past one
+            # leader group ('auto', HVD_NEGOTIATION_GROUP_SIZE) the
+            # round runs member -> leader -> cross-leader -> fan-down,
+            # dropping per-gather server fan-in from O(world) keys to
+            # O(world/G + G); small worlds keep the flat exchange
+            # byte-for-byte.
+            if envs.hier_negotiation_enabled(len(member_procs)):
+                from .negotiation import HierarchicalTransport
+                transport = HierarchicalTransport(
+                    kv, len(member_procs), member_procs.index(me),
+                    prefix=prefix)
+            else:
+                transport = KVTransport(kv, len(member_procs),
+                                        member_procs.index(me),
+                                        prefix=prefix)
             svc = DynamicService(engine, transport,
                                  global_ranks=member_procs,
                                  # one tenant, one label value: the
@@ -743,6 +880,21 @@ def get_service(pset=None) -> DynamicService | None:
             hvd_logging.warning("dynamic engine service unavailable: %s", e)
             scope.unavailable = True
     return svc
+
+
+def response_cache_stats() -> dict:
+    """Per-process-set coordinator ResponseCache views for this world's
+    services (exported as ``hvd.response_cache_stats()``); empty when
+    ``HVD_RESPONSE_CACHE`` is off or no service is up."""
+    scope = _ServiceScope()
+    with _service_lock:
+        svcs = dict(scope.table)
+    out = {}
+    for key, svc in svcs.items():
+        stats = svc.response_cache_stats()
+        if stats is not None:
+            out["global" if key == "0" else key] = stats
+    return out
 
 
 def reset_service() -> None:

@@ -89,12 +89,25 @@ class HealthWatchdog:
 
     def __init__(self, kv, world_size: int, rank: int, prefix: str,
                  on_failure, interval_s: float | None = None,
-                 timeout_s: float | None = None, global_ranks=None):
+                 timeout_s: float | None = None, global_ranks=None,
+                 layout=None):
         self.kv = kv
         self.world_size = world_size
         self.rank = rank
         self.prefix = prefix.rstrip("/")
         self.on_failure = on_failure
+        # Hierarchical beat channel (docs/negotiation.md): with a
+        # GroupLayout, beats publish under per-group scopes and each
+        # group's leader aggregates its members' counters into ONE
+        # ``agg/<gid>`` blob per tick — a monitor then reads its own
+        # group's raw beats plus the O(world/G) aggregates instead of
+        # O(world) keys. A dead LEADER freezes its whole group's
+        # counters from a remote monitor's view; the leader carries the
+        # group's smallest rank, so sorted silence detection names the
+        # leader first — exactly the failure the aggregation introduced.
+        self.layout = layout
+        self._gid = layout.group_of(rank) if layout is not None else 0
+        self._leads = layout.is_leader(rank) if layout is not None else False
         self.interval_s = (interval_s if interval_s is not None
                            else envs.health_interval_s())
         self.timeout_s = (timeout_s if timeout_s is not None
@@ -154,6 +167,8 @@ class HealthWatchdog:
     # -- protocol ----------------------------------------------------------
 
     def _beat_key(self, rank: int) -> str:
+        if self.layout is not None:
+            return f"{self.prefix}/b{self.layout.group_of(rank)}/{rank}"
         return f"{self.prefix}/beat/{rank}"
 
     def _poison_key(self, rank: int) -> str:
@@ -233,6 +248,8 @@ class HealthWatchdog:
         transport failure (the caller must not age peers on OUR error).
         In-memory KVs (tests, the driver-side server) fall back to
         direct gets — no HTTP involved there."""
+        if self.layout is not None:
+            return self._fetch_beats_hier()
         prefix = f"{self.prefix}/beat"
         gather = getattr(self.kv, "gather", None)
         try:
@@ -254,6 +271,90 @@ class HealthWatchdog:
                 out[int(key.rsplit("/", 1)[1])] = int(raw.decode())
             except (ValueError, UnicodeDecodeError):
                 continue
+        return out
+
+    def _scope_counters(self, scope: str) -> dict[int, int] | None:
+        """``{rank: counter}`` for every beat key under ``scope``; {} on
+        no keys yet, None on a transport failure (never age on OUR
+        error)."""
+        gather = getattr(self.kv, "gather", None)
+        try:
+            if gather is not None:
+                # Short server wait: our own beat satisfies the count
+                # for our group scope, so this returns immediately; the
+                # short timeout only bounds the startup window before
+                # any key exists. A BLOCKING wait here would stretch the
+                # monitor tick past interval_s and delay our own next
+                # beat — peers would read the slow monitor as a death.
+                got = gather(scope, 1, timeout=0.05)
+            else:
+                got = {}
+                for r in list(self._seen) + [self.rank]:
+                    key = self._beat_key(r)
+                    if key.startswith(scope + "/"):
+                        raw = self.kv.get(key)
+                        if raw is not None:
+                            got[key] = raw
+        except TimeoutError:
+            return {}
+        except Exception:
+            return None
+        out: dict[int, int] = {}
+        for key, raw in got.items():
+            try:
+                out[int(key.rsplit("/", 1)[1])] = int(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+    def _fetch_beats_hier(self) -> dict[int, int] | None:
+        """Leader-aggregated beat fetch: own group's raw beats +
+        every group's ``agg/<gid>`` blob; a leader also REPUBLISHES its
+        group's aggregate from the raw beats it just read, so the
+        aggregate advances exactly while the leader lives."""
+        mine = self._scope_counters(f"{self.prefix}/b{self._gid}")
+        if mine is None:
+            return None
+        if self._leads:
+            try:
+                self.kv.put(f"{self.prefix}/agg/{self._gid}",
+                            json.dumps({str(r): c
+                                        for r, c in sorted(mine.items())}
+                                       ).encode())
+            except Exception as e:
+                hvd_logging.warning(
+                    "health: beat aggregate publish failed: %s", e)
+        out = dict(mine)
+        gather = getattr(self.kv, "gather", None)
+        try:
+            if gather is not None:
+                # non-blocking read of whatever aggregates exist: before
+                # the first leader publishes there is nothing to wait
+                # for, and blocking here would starve our own beats
+                aggs = gather(f"{self.prefix}/agg", 1, timeout=0.05)
+            else:
+                aggs = {}
+                for g in range(self.layout.n_groups):
+                    raw = self.kv.get(f"{self.prefix}/agg/{g}")
+                    if raw is not None:
+                        aggs[f"{self.prefix}/agg/{g}"] = raw
+        except TimeoutError:
+            aggs = {}  # no leader has aggregated yet: startup grace
+        except Exception:
+            return None
+        for key, blob in aggs.items():
+            try:
+                gid = int(key.rsplit("/", 1)[1])
+                counters = json.loads(blob.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if gid == self._gid:
+                continue  # own group: the raw beats are fresher
+            for r, c in counters.items():
+                try:
+                    out.setdefault(int(r), int(c))
+                except (TypeError, ValueError):
+                    continue
         return out
 
     def _check_poison(self):

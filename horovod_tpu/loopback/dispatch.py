@@ -27,14 +27,68 @@ reference JoinOp semantics.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 from . import context as _ctx
 from ..utils import envs
 
 DEFAULT_LOOPBACK_TIMEOUT_S = 120.0
 
+# Per-scope cap on the occurrence table (see ``_next_occurrence``):
+# auto-named collectives never recur, so a long world=64 run would grow
+# one dead entry per collective per rank without eviction.
+_XSEQ_CAP = 2048
+
 
 def _timeout_s() -> float:
-    return envs.get_float(envs.LOOPBACK_TIMEOUT, DEFAULT_LOOPBACK_TIMEOUT_S)
+    """Deadline for one loopback rendezvous. The default scales with
+    world size (ISSUE 13 loopback-scale audit): at world=64 the 2-core
+    CI box runs 64 rank threads over 64 virtual devices, so a
+    first-call compile + the world's worth of contending collectives
+    legitimately takes several small-world timeouts. An explicit
+    ``HVD_LOOPBACK_TIMEOUT`` is honored as-is."""
+    explicit = envs.get(envs.LOOPBACK_TIMEOUT)
+    if explicit is not None:
+        try:
+            return float(explicit)
+        except ValueError:
+            pass
+    from .. import runtime
+    n = runtime.process_count() if runtime.is_initialized() else 1
+    return DEFAULT_LOOPBACK_TIMEOUT_S * max(1.0, n / 16.0)
+
+
+def _next_occurrence(ctx, scope, name) -> int:
+    """The per-``(scope, name)`` occurrence counter disambiguating
+    steady-state name reuse, stored per scope in insertion order with an
+    LRU cap. Eviction is deterministic across the scope's member ranks:
+    each rank touches the scope's names in the globally-agreed
+    negotiation order, so every member evicts the same name at the same
+    per-scope usage index — an evicted name that recurs restarts at
+    occurrence 0 on every rank simultaneously."""
+    table = ctx.xseq.get(scope)
+    if table is None:
+        table = ctx.xseq[scope] = OrderedDict()
+    occurrence = table.get(name, 0)
+    table[name] = occurrence + 1
+    table.move_to_end(name)
+    while len(table) > _XSEQ_CAP:
+        table.popitem(last=False)
+    return occurrence
+
+
+def prune_stale_scopes(ctx) -> None:
+    """Drop occurrence tables from previous world incarnations (elastic
+    re-forms re-seed the coordinator scope): their slot ids can never
+    recur, so keeping them is a per-round leak. Called from the loopback
+    ``runtime.init`` branch."""
+    addr = envs.get(envs.COORDINATOR_ADDR, "local")
+    port = envs.get(envs.COORDINATOR_PORT, "0")
+    for scope in list(ctx.xseq):
+        live = (scope[:2] == (addr, port)
+                or scope[:3] == ("obj", addr, port))
+        if not live:
+            del ctx.xseq[scope]
 
 
 def active() -> bool:
@@ -110,9 +164,7 @@ def channel(pset, name) -> Channel | None:
     scope = (envs.get(envs.COORDINATOR_ADDR, "local"),
              envs.get(envs.COORDINATOR_PORT, "0"),
              engine_service._set_key(pset), ranks)
-    seq_key = (scope, str(name))
-    occurrence = ctx.xseq.get(seq_key, 0)
-    ctx.xseq[seq_key] = occurrence + 1
+    occurrence = _next_occurrence(ctx, scope, str(name))
     slot_id = scope + (str(name), occurrence)
     return Channel(ctx.world.hub, slot_id, pos, len(ranks),
                    _failure_probe(ctx, pset))
@@ -137,8 +189,7 @@ def object_channel() -> Channel | None:
     ctx.check_alive()
     scope = ("obj", envs.get(envs.COORDINATOR_ADDR, "local"),
              envs.get(envs.COORDINATOR_PORT, "0"))
-    occurrence = ctx.xseq.get(scope, 0)
-    ctx.xseq[scope] = occurrence + 1
+    occurrence = _next_occurrence(ctx, scope, "")
     slot_id = scope + (occurrence,)
     from ..process_sets import global_process_set
     return Channel(ctx.world.hub, slot_id, runtime.process_rank(), n,

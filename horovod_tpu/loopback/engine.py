@@ -252,17 +252,23 @@ class LoopbackWorld:
         thread.start()
         return handle
 
-    def run(self, fn, *, timeout: float | None = 300.0,
+    def run(self, fn, *, timeout="auto",
             allow_failures: bool = False, extra_env=None) -> list[Outcome]:
         """Run ``fn()`` on every rank of a fresh static round (each rank
         auto-``init()``s its loopback runtime first; ``fn`` may call
         ``hvd.init()`` again harmlessly). Returns per-rank
         :class:`Outcome`\\ s; unless ``allow_failures``, the first rank
         error re-raises. ``timeout=None`` supervises without a deadline
-        (the launcher path — a training job runs as long as it runs)."""
+        (the launcher path — a training job runs as long as it runs);
+        the ``"auto"`` default scales the 300 s small-world deadline
+        with world size — 64 rank threads time-slicing a 2-core CI box
+        legitimately need several small-world budgets (ISSUE 13
+        loopback-scale audit)."""
         n = self.size
         if not n or n < 1:
             raise ValueError("LoopbackWorld.run needs a world size")
+        if timeout == "auto":
+            timeout = 300.0 * max(1.0, n / 16.0)
         _check_devices(n)
         self._round += 1
         handles = [self.spawn(fn, self.rank_env(r, n, extra=extra_env),
@@ -372,6 +378,18 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
     from ..elastic.bootstrap import make_elastic_infra
     from ..runner.launch import _free_port
 
+    base_env = dict(extra_env or {})
+    if timeout is None and envs.get(envs.ELASTIC_TIMEOUT) is None:
+        # elastic round/start deadlines scale with world size like the
+        # static run deadline (ISSUE 13 loopback-scale audit); an
+        # explicit HVD_ELASTIC_TIMEOUT or timeout= is honored as-is.
+        # The scaled value is ALSO seeded into the worker overlays:
+        # each worker's rendezvous reads HVD_ELASTIC_TIMEOUT itself,
+        # and an unscaled worker would give up at 600 s while the
+        # driver is still within its scaled budget.
+        timeout = 600.0 * max(1.0, (max_np or np) / 16.0)
+        base_env.setdefault("HVD_ELASTIC_TIMEOUT", str(int(timeout)))
+
     infra = make_elastic_infra(
         discovery, min_np or np, max_np, timeout=timeout,
         reset_limit=reset_limit,
@@ -383,7 +401,6 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
     w = LoopbackWorld(kv_addr=infra.kv_addr, kv_port=infra.kv_port,
                       secret=infra.secret)
     driver = infra.driver
-    base_env = dict(extra_env or {})
 
     def create_worker_fn(slot_info, spec_round: int):
         spec = infra.round_spec(spec_round)

@@ -14,13 +14,22 @@ participant then returns the identical result object. Numerics are
 therefore identical to the world=1 path by construction, not by
 re-implementation.
 
+**Sharding (ISSUE 13).** The slot registry is partitioned into
+``_N_SHARDS`` independent ``(condition, slots)`` shards keyed by a hash
+of the slot id: at world=64 every rank thread otherwise serializes on
+one hub lock per collective, and — worse — every slot completion
+``notify_all``s every parked waiter of every *other* slot, an O(world²)
+thundering herd per step. Unrelated collectives now rendezvous on
+unrelated conditions; a slot's waiters share a shard with only ~1/16th
+of the world.
+
 Failure semantics: waits poll a caller-provided ``failure_check`` (the
 rank's negotiation-service failure state, fed by the health watchdog)
 so a peer death surfaces as :class:`~horovod_tpu.exceptions.
 PeerFailureError` within the watchdog budget instead of the full
-exchange deadline; :meth:`fail_all` poisons every pending slot at world
-teardown. Slots are reference-counted and deleted once every
-participant consumed the result.
+exchange deadline; :meth:`fail_all` poisons every pending slot (on
+every shard) at world teardown. Slots are reference-counted and deleted
+once every participant consumed the result.
 
 All blocking goes through the ``utils/invariants.py`` constructor seam,
 so the whole rendezvous is explorable and replayable under
@@ -30,12 +39,18 @@ model) and witness-checked under ``HVD_DEBUG_INVARIANTS=1``.
 
 from __future__ import annotations
 
+import zlib
+
 from ..utils import invariants as _inv
 
 # Wait-slice while parked on a slot: short enough that a failure_check
 # hit (watchdog-detected peer death) surfaces promptly, long enough not
 # to spin. Virtualized under HVD_SCHED_CHECK.
 _WAIT_SLICE_S = 0.2
+
+# Shard count: enough that 64 rank threads rarely collide on a shard
+# lock, few enough that a fail_all sweep is cheap.
+_N_SHARDS = 16
 
 
 class ExchangeTimeout(RuntimeError):
@@ -58,11 +73,24 @@ class _Slot:
         self.consumed = 0
 
 
+class _Shard:
+    __slots__ = ("cv", "slots")
+
+    def __init__(self, cv):
+        self.cv = cv
+        self.slots: dict[tuple, _Slot] = {}
+
+
 class LoopbackHub:
     def __init__(self, name: str = "loopback"):
-        self._cv = _inv.make_condition(f"{name}.hub.cv")
-        self._slots: dict[tuple, _Slot] = {}
+        self._shards = [
+            _Shard(_inv.make_condition(f"{name}.hub.cv{i}"))
+            for i in range(_N_SHARDS)]
         self._failure: BaseException | None = None
+
+    def _shard(self, slot_id: tuple) -> _Shard:
+        h = zlib.crc32(repr(slot_id).encode())
+        return self._shards[h % _N_SHARDS]
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -71,14 +99,15 @@ class LoopbackHub:
         unrecoverable rank failure. Parked waiters raise ``exc``; they
         hold direct slot references, so the registry can drop the slots
         immediately (payload tensors must not outlive the failure)."""
-        with self._cv:
-            self._failure = exc
-            for slot in self._slots.values():
-                if not slot.done:
-                    slot.error = exc
-                    slot.done = True
-            self._slots.clear()
-            self._cv.notify_all()
+        self._failure = exc  # visible to every shard's next check
+        for shard in self._shards:
+            with shard.cv:
+                for slot in shard.slots.values():
+                    if not slot.done:
+                        slot.error = exc
+                        slot.done = True
+                shard.slots.clear()
+                shard.cv.notify_all()
 
     # -- the rendezvous primitive ------------------------------------------
 
@@ -91,13 +120,14 @@ class LoopbackHub:
         every participant returns its result. ``compute`` runs with no
         hub lock held (it issues compiled mesh programs)."""
         deadline = _inv.monotonic() + timeout
+        shard = self._shard(slot_id)
         lead = False
-        with self._cv:
+        with shard.cv:
             self._raise_poisoned()
-            slot = self._slots.get(slot_id)
+            slot = shard.slots.get(slot_id)
             if slot is None:
                 slot = _Slot(count)
-                self._slots[slot_id] = slot
+                shard.slots[slot_id] = slot
             if pos in slot.values or slot.count != count:
                 raise RuntimeError(
                     f"loopback exchange {slot_id!r}: duplicate or "
@@ -109,7 +139,7 @@ class LoopbackHub:
                 slot.computing = True
                 lead = True
                 ordered = [slot.values[p] for p in sorted(slot.values)]
-            self._cv.notify_all()
+            shard.cv.notify_all()
         if lead:
             result = None
             error = None
@@ -117,23 +147,23 @@ class LoopbackHub:
                 result = compute(ordered)
             except BaseException as e:
                 error = e
-            with self._cv:
+            with shard.cv:
                 slot.result = result
                 slot.error = error
                 slot.done = True
-                self._cv.notify_all()
-            return self._consume(slot_id, slot)
-        with self._cv:
+                shard.cv.notify_all()
+            return self._consume(shard, slot_id, slot)
+        with shard.cv:
             while not slot.done:
                 exc = failure_check() if failure_check is not None else None
                 if exc is not None:
                     # the slot may still complete for the other waiters;
                     # this participant gives up with the failure it saw
-                    self._abandon_locked(slot_id, slot)
+                    self._abandon_locked(shard, slot_id, slot)
                     raise exc
                 remaining = deadline - _inv.monotonic()
                 if remaining <= 0 and not slot.computing:
-                    self._abandon_locked(slot_id, slot)
+                    self._abandon_locked(shard, slot_id, slot)
                     # timeout applies to MISSING participants only: once
                     # every rank posted and the leader is computing (a
                     # first-call compile can be slow under load), the
@@ -144,9 +174,9 @@ class LoopbackHub:
                         f"{timeout:g}s waiting for participants {missing} "
                         "(a rank never issued the matching collective, "
                         "or died before the watchdog noticed)")
-                self._cv.wait(_WAIT_SLICE_S if remaining <= 0
+                shard.cv.wait(_WAIT_SLICE_S if remaining <= 0
                               else min(remaining, _WAIT_SLICE_S))
-        return self._consume(slot_id, slot)
+        return self._consume(shard, slot_id, slot)
 
     def exchange(self, slot_id: tuple, pos: int, count: int, payload, *,
                  timeout: float, failure_check=None) -> list:
@@ -163,7 +193,8 @@ class LoopbackHub:
         if self._failure is not None:
             raise self._failure
 
-    def _abandon_locked(self, slot_id: tuple, slot: _Slot) -> None:
+    def _abandon_locked(self, shard: _Shard, slot_id: tuple,
+                        slot: _Slot) -> None:
         """A waiter gives up (peer death / timeout): count it as consumed
         and drop the slot once every KNOWN poster has given up — a dead
         rank never posts, so waiting for ``count`` consumptions would pin
@@ -173,18 +204,21 @@ class LoopbackHub:
         slot.consumed += 1
         threshold = slot.count if slot.done else len(slot.values)
         if slot.consumed >= threshold:
-            self._slots.pop(slot_id, None)
+            shard.slots.pop(slot_id, None)
 
-    def _consume(self, slot_id: tuple, slot: _Slot):
-        with self._cv:
+    def _consume(self, shard: _Shard, slot_id: tuple, slot: _Slot):
+        with shard.cv:
             slot.consumed += 1
             if slot.consumed >= slot.count:
-                self._slots.pop(slot_id, None)
+                shard.slots.pop(slot_id, None)
             error, result = slot.error, slot.result
         if error is not None:
             raise error
         return result
 
     def pending(self) -> int:
-        with self._cv:
-            return len(self._slots)
+        total = 0
+        for shard in self._shards:
+            with shard.cv:
+                total += len(shard.slots)
+        return total

@@ -376,6 +376,16 @@ STRAGGLER_ROUNDS = counter(
     "Rounds in which the labeled global rank was last to submit by more "
     "than HVD_STRAGGLER_THRESHOLD (the stall-check analog).",
     labels=("rank",))
+RESPONSE_CACHE_HITS = counter(
+    "hvd_response_cache_hits_total",
+    "Negotiation requests served locally from the coordinator "
+    "ResponseCache (HVD_RESPONSE_CACHE) — zero KV rounds.",
+    labels=("process_set",))
+RESPONSE_CACHE_MISSES = counter(
+    "hvd_response_cache_misses_total",
+    "Cacheable negotiation requests that took a full round (entry "
+    "absent, unconfirmed, invalidated, or a join in flight).",
+    labels=("process_set",))
 
 # -- KV transport (runner/http_kv.KVClient) --------------------------------
 KV_OPS = counter(

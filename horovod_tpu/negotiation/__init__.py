@@ -1,0 +1,20 @@
+"""Hierarchical negotiation control plane + coordinator ResponseCache.
+
+The control-plane twin of ``ops/hierarchical.py``'s ICI-then-DCN data
+path (docs/negotiation.md): ranks are partitioned into leader groups
+(:mod:`~horovod_tpu.negotiation.layout`), a negotiation round travels
+member → leader → cross-leader exchange → fan-down
+(:mod:`~horovod_tpu.negotiation.hierarchy`), and a per-service
+:class:`~horovod_tpu.negotiation.response_cache.ResponseCache` serves
+steady-state rounds locally once the protocol's AND-ed cache bit vector
+has proven every rank holds the response — PAPER.md's coordinator
+ResponseCache applied at the service seam, so ``negotiate_many_submit``
+/ ``_wait`` keep their ticket contract and everything above
+(``fusion_cycle``, QoS, step capture) is untouched.
+"""
+
+from .layout import GroupLayout
+from .response_cache import ResponseCache
+from .hierarchy import HierarchicalTransport
+
+__all__ = ["GroupLayout", "ResponseCache", "HierarchicalTransport"]

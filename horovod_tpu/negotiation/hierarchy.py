@@ -1,0 +1,155 @@
+"""Two-level negotiation exchange: member → leader → cross-leader → fan-down.
+
+The control-plane twin of ``ops/hierarchical.py``'s ICI-then-DCN data
+path. The flat :class:`~horovod_tpu.engine_service.KVTransport` has
+every rank put its frame and gather **all** ``world`` frames — the KV
+server assembles ``world`` keys for ``world`` gathers every round.
+Here one round is:
+
+1. every rank PUTs its frame under its group's scope;
+2. each group's **leader** gathers its ≤G member frames (one long-poll),
+   packs them — with the server-clock receipt time of each — into one
+   group blob, and PUTs it to the cross-leader scope;
+3. leaders gather the ``world/G`` group blobs (the one cross-leader
+   exchange), merge them into the full rank-ordered table, and PUT it
+   as their group's fan-down key;
+4. members long-poll their group's single fan-down key.
+
+Per round a member performs O(1) KV ops and the server assembles
+O(G) keys per leader group gather plus O(world/G) per cross-leader
+gather — O(world/G + G) instead of O(world) per gather. Bytes are
+unchanged (every rank still receives every frame: the engine ingests
+all ranks); *ops and fan-in* are what shrink, which is exactly what the
+single coordinator's ceiling is made of.
+
+The wire **frame** is byte-identical to the flat transport's
+(``<u32 len>request_bytes cache_bits``), and the transport exposes the
+same surface (``kv``/``world_size``/``rank``/``prefix``/
+``last_round_s``/``last_lags``/``exchange``), so ``DynamicService``,
+the watchdog wiring, and the straggler tracker run unmodified on
+either. Leader/member role branches live HERE, below the collective
+submission surface — conditioning a *collective* on leader role is the
+rank-divergence hang class hvdlint pass 7 flags.
+
+Leader failure: a dead leader stops beating like any rank; the
+watchdog's leader-aggregated beat channel (``health.py``) names it
+within the health budget and the coordinated abort fails every parked
+exchange. On the next (re-formed) round the layout is re-derived from
+the new world, promoting the next surviving rank to leader
+(``negotiation/layout.py``).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+
+from .layout import GroupLayout
+from ..utils import envs
+from ..utils import faults as _faults
+
+
+def _pack_entries(entries: list[tuple[int, float, bytes]]) -> bytes:
+    """``[(rank, server_receipt_s, frame)]`` → one blob."""
+    out = [struct.pack("<I", len(entries))]
+    for rank, receipt, frame in entries:
+        out.append(struct.pack("<IdI", rank, receipt, len(frame)))
+        out.append(frame)
+    return b"".join(out)
+
+
+def _unpack_entries(blob: bytes) -> list[tuple[int, float, bytes]]:
+    (n,) = struct.unpack_from("<I", blob, 0)
+    pos = 4
+    entries = []
+    for _ in range(n):
+        rank, receipt, ln = struct.unpack_from("<IdI", blob, pos)
+        pos += 16
+        entries.append((rank, receipt, blob[pos:pos + ln]))
+        pos += ln
+    return entries
+
+
+class HierarchicalTransport:
+    """Drop-in replacement for the flat ``KVTransport`` running the
+    two-level protocol over the same launcher KV server."""
+
+    def __init__(self, kv_client, world_size: int, rank: int,
+                 prefix: str = "engine", group_size: int | None = None):
+        self.kv = kv_client
+        self.world_size = world_size
+        self.rank = rank
+        self.prefix = prefix
+        self.group_layout = GroupLayout(
+            world_size,
+            group_size if group_size is not None
+            else envs.negotiation_group_size())
+        self._gid = self.group_layout.group_of(rank)
+        self._leads = self.group_layout.is_leader(rank)
+        # same observability surface as KVTransport (read by the
+        # service's round-metrics hook and the straggler tracker)
+        self.last_round_s = 0.0
+        self.last_lags: dict[int, float] = {}
+
+    def exchange(self, cycle: int, req_bytes: bytes, bits: bytes,
+                 timeout: float) -> tuple[list[bytes], list[bytes]]:
+        """One two-level round; returns the same rank-ordered
+        ``(datas, bitvs)`` the flat transport returns."""
+        _faults.inject("svc.exchange")
+        t0 = time.monotonic()
+        frame = struct.pack("<I", len(req_bytes)) + req_bytes + bits
+        base = f"{self.prefix}/h/{cycle}"
+        gid = self._gid
+        self.kv.put(f"{base}/g{gid}/{self.rank}", frame)
+        if self._leads:
+            members = self.group_layout.members_of(gid)
+            got, times = self.kv.gather(f"{base}/g{gid}", len(members),
+                                        timeout=timeout, with_times=True)
+            entries = []
+            for k, v in got.items():
+                try:
+                    r = int(k.rsplit("/", 1)[1])
+                except ValueError:
+                    continue
+                entries.append((r, times.get(k, 0.0), v))
+            entries.sort()
+            self.kv.put(f"{base}/x/{gid}", _pack_entries(entries))
+            xs = self.kv.gather(f"{base}/x", self.group_layout.n_groups,
+                                timeout=timeout)
+            merged: list[tuple[int, float, bytes]] = []
+            for blob in xs.values():
+                merged.extend(_unpack_entries(blob))
+            merged.sort()
+            combined = _pack_entries(merged)
+            self.kv.put(f"{base}/r{gid}/all", combined)
+        else:
+            got = self.kv.gather(f"{base}/r{gid}", 1, timeout=timeout)
+            merged = _unpack_entries(next(iter(got.values())))
+        self.last_round_s = time.monotonic() - t0
+        datas: list = [b""] * self.world_size
+        bitvs: list = [b""] * self.world_size
+        receipt: dict[int, float] = {}
+        for r, t, fr in merged:
+            if not 0 <= r < self.world_size:
+                continue
+            (ln,) = struct.unpack_from("<I", fr, 0)
+            datas[r] = fr[4:4 + ln]
+            bitvs[r] = fr[4 + ln:]
+            receipt[r] = t
+        first = min(receipt.values()) if receipt else 0.0
+        self.last_lags = {r: t - first for r, t in sorted(receipt.items())}
+        # Same memory bound as the flat transport: everyone read cycle-c
+        # data before anyone writes cycle c+2, so cycle c-1's keys are
+        # dead — each rank clears its own, leaders also their two
+        # aggregate keys.
+        if cycle > 0:
+            prev = f"{self.prefix}/h/{cycle - 1}"
+            stale = [f"{prev}/g{gid}/{self.rank}"]
+            if self._leads:
+                stale += [f"{prev}/x/{gid}", f"{prev}/r{gid}/all"]
+            for key in stale:
+                try:
+                    self.kv.delete(key)
+                except Exception:  # hvdlint: disable=silent-except
+                    pass  # best-effort memory bound; keys are round-scoped
+        return datas, bitvs

@@ -1,0 +1,72 @@
+"""Static leader-group layout for the hierarchical control plane.
+
+A pure function of ``(world_size, group_size)`` — no knob reads, no
+runtime state — so every rank derives the identical layout and the
+hvdlint rank-divergence rule can treat layout *shape* queries
+(``n_groups``, ``leaders()``, ``members_of``) as rank-symmetric. The
+self-role predicate (:meth:`GroupLayout.is_leader`) is rank-LOCAL: a
+collective submission conditioned on it is the mismatched-collective
+hang class and is flagged by hvdlint pass 7 (leader-role taint).
+
+Group ``g`` covers ranks ``[g*G, min((g+1)*G, world))``; its leader is
+the group's smallest rank. ``G ∤ world`` simply leaves the last group
+short — a one-member group is its own leader with no member traffic.
+After an elastic re-form the layout is recomputed from the new world
+size, so a dead leader's group is re-led by the next surviving rank on
+the very next round (member promotion = re-derivation, never a
+stateful election).
+"""
+
+from __future__ import annotations
+
+
+class GroupLayout:
+    """Partition of ``world_size`` transport-local ranks into leader
+    groups of at most ``group_size``."""
+
+    __slots__ = ("world_size", "group_size", "n_groups")
+
+    def __init__(self, world_size: int, group_size: int):
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if group_size < 1:
+            raise ValueError(f"group_size must be >= 1, got {group_size}")
+        self.world_size = int(world_size)
+        self.group_size = int(group_size)
+        self.n_groups = -(-self.world_size // self.group_size)  # ceil
+
+    def group_of(self, rank: int) -> int:
+        self._check(rank)
+        return rank // self.group_size
+
+    def leader_of(self, group: int) -> int:
+        if not 0 <= group < self.n_groups:
+            raise ValueError(f"group {group} out of range "
+                             f"[0, {self.n_groups})")
+        return group * self.group_size
+
+    def leaders(self) -> list[int]:
+        return [g * self.group_size for g in range(self.n_groups)]
+
+    def members_of(self, group: int) -> range:
+        """Every rank of ``group`` (leader included), ascending."""
+        if not 0 <= group < self.n_groups:
+            raise ValueError(f"group {group} out of range "
+                             f"[0, {self.n_groups})")
+        lo = group * self.group_size
+        return range(lo, min(lo + self.group_size, self.world_size))
+
+    def is_leader(self, rank: int) -> bool:
+        """Whether ``rank`` leads its group — a rank-LOCAL role; never
+        condition a collective submission on it (hvdlint pass 7)."""
+        self._check(rank)
+        return rank % self.group_size == 0
+
+    def _check(self, rank: int) -> None:
+        if not 0 <= rank < self.world_size:
+            raise ValueError(
+                f"rank {rank} out of range [0, {self.world_size})")
+
+    def __repr__(self):
+        return (f"GroupLayout(world={self.world_size}, "
+                f"G={self.group_size}, groups={self.n_groups})")

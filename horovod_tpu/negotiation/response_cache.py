@@ -1,0 +1,205 @@
+"""Coordinator ResponseCache: steady-state negotiation served locally.
+
+PAPER.md's ResponseCache design applied at the *service* seam: once a
+tensor's negotiation response is cached on every rank — a fact the
+protocol itself proves — later submissions of the identical request are
+answered from the local cache with **zero** KV rounds, so steady-state
+negotiation cost is independent of world size (docs/negotiation.md).
+
+**Coherence rule.** An entry is only *confirmed* (serveable) after a
+real round returned its response with ``from_cache=True``. That flag is
+produced by the native engines' AND-ed cache **bit vector**
+(``commit_cache_bits(and_bitvectors(...))``): it is set iff *every*
+rank's native cache held the entry that cycle, and the symmetric
+protocol delivers it at the same negotiation index on every rank — so
+all ranks flip from "negotiate" to "serve locally" deterministically at
+the same occurrence, keeping downstream pairing (loopback hub
+occurrence counters, cross-process program issue order) aligned with no
+extra wire traffic. A rank whose bit diverges (capacity eviction,
+metadata drift) drops the AND, the response comes back
+``from_cache=False``, and the entry stays unconfirmed — bit-vector
+divergence forces re-negotiation by construction.
+
+**Invalidation.** Serving additionally requires the native cache to
+still hold the name (``NativeEngine.cache_has``): native invalidation
+is driven by the globally-ingested request stream, so every rank stops
+serving on the same cycle a peer's changed-metadata request lands.
+Whole-cache invalidation on knob-override epoch bumps, service
+reset/stop (process-set change, elastic re-form — a re-formed world
+builds fresh services and therefore fresh caches), and coordinated
+abort. While any rank is JOINed (``NativeEngine.join_pending``) the
+service bypasses the cache entirely: the joined rank only learns about
+scheduled collectives — for its zero executions — from real rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from .. import metrics as _metrics
+from ..dynamic import (
+    REQ_ALLGATHER,
+    REQ_BARRIER,
+    REQ_JOIN,
+    Response,
+)
+
+
+def cacheable(req: dict) -> bool:
+    """Whether a request is eligible for response caching — mirrors the
+    native cache's own rules (``cache_bits``): allgathers carry per-rank
+    first dims no rank can vouch for alone, uneven alltoalls have
+    call-specific recv splits, barriers/joins are never cached."""
+    t = req.get("request_type")
+    if t in (REQ_ALLGATHER, REQ_BARRIER, REQ_JOIN):
+        return False
+    if tuple(req.get("splits") or ()):
+        return False
+    return True
+
+
+def signature(req: dict) -> tuple:
+    """Full request identity: a cached response may only answer a
+    request that matches in every negotiated dimension (the native
+    cache compares the same params and calls a drift INVALID)."""
+    return (
+        req["name"],
+        req.get("request_type"),
+        req.get("dtype", 0),
+        req.get("element_size", 4),
+        tuple(req.get("shape", ())),
+        req.get("root_rank", -1),
+        req.get("group_id", -1),
+        req.get("reduce_op", -1),
+        float(req.get("prescale", 1.0)),
+        float(req.get("postscale", 1.0)),
+        req.get("splits_crc", 0),
+    )
+
+
+class _Entry:
+    __slots__ = ("response", "confirmed")
+
+    def __init__(self, response: Response, confirmed: bool):
+        self.response = response
+        self.confirmed = confirmed
+
+
+class ResponseCache:
+    """One negotiation service's response cache (LRU, ``capacity``
+    entries — the ``HVD_RESPONSE_CACHE`` knob). Thread-safe: submit
+    paths look up under ``_mu`` while the wait path inserts."""
+
+    def __init__(self, capacity: int, pset_key: str = "global"):
+        self.capacity = int(capacity)
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[str, tuple[tuple, _Entry]]" = \
+            OrderedDict()  # name -> (signature, entry)
+        self._hits = 0
+        self._misses = 0
+        self._served_batches = 0
+        self._invalidations = 0
+        label = {"process_set": pset_key}
+        self._m_hits = _metrics.RESPONSE_CACHE_HITS.bind(label)
+        self._m_misses = _metrics.RESPONSE_CACHE_MISSES.bind(label)
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup_confirmed(self, req: dict) -> Response | None:
+        """The cached response for ``req`` when its entry is confirmed
+        globally coherent AND matches the full signature; else None.
+        Does not count hit/miss — the service counts per *decision*
+        (a batch is served all-or-nothing)."""
+        if self.capacity <= 0 or not cacheable(req):
+            return None
+        sig = signature(req)
+        with self._mu:
+            held = self._entries.get(req["name"])
+            if held is None:
+                return None
+            held_sig, entry = held
+            if held_sig != sig or not entry.confirmed:
+                return None
+            self._entries.move_to_end(req["name"])
+            return entry.response
+
+    # -- population --------------------------------------------------------
+
+    def note_response(self, req: dict, resp: Response) -> None:
+        """Record a delivered negotiation response. ``from_cache=True``
+        responses confirm the entry (the AND-ed bit vector proved every
+        rank holds it — see the module docstring); fresh responses
+        insert/update unconfirmed."""
+        if self.capacity <= 0 or not cacheable(req) or resp.is_error:
+            return
+        if len(resp.tensor_names) != 1:
+            return  # fused multi-tensor responses are not per-name reusable
+        sig = signature(req)
+        with self._mu:
+            held = self._entries.get(req["name"])
+            if held is not None and held[0] == sig:
+                held[1].confirmed = held[1].confirmed or resp.from_cache
+                held[1].response = resp
+                self._entries.move_to_end(req["name"])
+                return
+            self._entries[req["name"]] = (sig, _Entry(resp, resp.from_cache))
+            self._entries.move_to_end(req["name"])
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    # -- accounting (service-side decisions) -------------------------------
+
+    def count_served(self, n: int) -> None:
+        with self._mu:
+            self._hits += n
+            self._served_batches += 1
+        self._m_hits.inc(n)
+
+    def count_missed(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._mu:
+            self._misses += n
+        self._m_misses.inc(n)
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, reason: str = "") -> int:
+        """Drop everything (knob-override epoch, service reset/stop,
+        coordinated abort). Returns the number of entries dropped."""
+        with self._mu:
+            n = len(self._entries)
+            self._entries.clear()
+            if n:
+                self._invalidations += 1
+        return n
+
+    def drop_name(self, name: str) -> None:
+        with self._mu:
+            self._entries.pop(name, None)
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def confirmed_count(self) -> int:
+        with self._mu:
+            return sum(1 for _, e in self._entries.values() if e.confirmed)
+
+    def stats(self) -> dict:
+        with self._mu:
+            total = self._hits + self._misses
+            return {
+                "capacity": self.capacity,
+                "entries": len(self._entries),
+                "confirmed": sum(1 for _, e in self._entries.values()
+                                 if e.confirmed),
+                "hits": self._hits,
+                "misses": self._misses,
+                "served_batches": self._served_batches,
+                "invalidations": self._invalidations,
+                "hit_rate": (self._hits / total) if total else 0.0,
+            }

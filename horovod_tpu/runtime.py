@@ -201,6 +201,11 @@ def _loopback_init(ctx, *, axis_name: str = AXIS_NAME,
         process_index=rank, process_count=size, local_ranks=[rank],
         process_set_table=table, rank_process_map=list(range(size)))
     table.initialize_global(size)
+    # Drop hub occurrence tables from previous world incarnations: an
+    # elastic re-form re-seeds the coordinator scope, so the old scopes'
+    # slot ids can never recur (loopback/dispatch.prune_stale_scopes).
+    from .loopback import dispatch as _lbdispatch
+    _lbdispatch.prune_stale_scopes(ctx)
     dynamic = (process_sets == "dynamic"
                or envs.get_bool(envs.DYNAMIC_PROCESS_SETS))
     table.dynamic_enabled = dynamic

@@ -70,7 +70,10 @@ RETRY_BACKOFF_MS = "RETRY_BACKOFF_MS"  # initial backoff between attempts
 RETRY_MAX_BACKOFF_MS = "RETRY_MAX_BACKOFF_MS"  # backoff growth cap
 RETRY_JITTER = "RETRY_JITTER"  # +/- fraction of deterministic jitter on backoff
 LOOPBACK = "LOOPBACK"  # "1" in loopback rank threads (hvd.loopback.world)
-LOOPBACK_TIMEOUT = "LOOPBACK_TIMEOUT"  # s per loopback collective rendezvous
+LOOPBACK_TIMEOUT = "LOOPBACK_TIMEOUT"  # s per loopback collective rendezvous (default scales with world)
+RESPONSE_CACHE = "RESPONSE_CACHE"  # coordinator ResponseCache: 0 off, 1 on (default capacity), >1 = capacity
+NEGOTIATION_GROUP_SIZE = "NEGOTIATION_GROUP_SIZE"  # ranks per leader group in the hierarchical control plane
+HIER_NEGOTIATION = "HIER_NEGOTIATION"  # auto|1|0: two-level leader/member negotiation exchange
 METRICS = "METRICS"  # unified metrics registry (0 = hot instruments off)
 METRICS_PORT = "METRICS_PORT"  # base port for the per-worker /metrics server
 STRAGGLER_THRESHOLD = "STRAGGLER_THRESHOLD"  # s of submit lag naming a rank a straggler
@@ -401,6 +404,45 @@ def qos_quantum_bytes() -> int:
 
 def qos_starve_limit() -> int:
     return get_int(QOS_STARVE_LIMIT, DEFAULT_QOS_STARVE_LIMIT)
+
+
+# Hierarchical negotiation control plane (horovod_tpu/negotiation/,
+# docs/negotiation.md). Group size 8 mirrors the data path's ICI-island
+# default (ops/hierarchical.py): one leader per "island" runs the
+# cross-leader exchange while members pay O(1) KV ops per round. The
+# coordinator ResponseCache is off by default — steady-state local
+# serving changes divergence *surfacing* (a diverged rank times out
+# instead of every rank seeing the mismatch error), so it is opt-in like
+# the reference's HOROVOD_CACHE_CAPACITY tuning.
+DEFAULT_NEGOTIATION_GROUP_SIZE = 8
+DEFAULT_RESPONSE_CACHE_CAPACITY = 1024
+
+
+def negotiation_group_size() -> int:
+    return max(1, get_int(NEGOTIATION_GROUP_SIZE,
+                          DEFAULT_NEGOTIATION_GROUP_SIZE))
+
+
+def response_cache_capacity() -> int:
+    """``HVD_RESPONSE_CACHE``: 0 (default) = off; 1 = on at the default
+    capacity; any larger value = on with that many entries."""
+    v = get_int(RESPONSE_CACHE, 0)
+    if v <= 0:
+        return 0
+    return DEFAULT_RESPONSE_CACHE_CAPACITY if v == 1 else v
+
+
+def hier_negotiation_enabled(world_size: int) -> bool:
+    """Whether the two-level (leader/member) negotiation exchange runs
+    for a service of ``world_size`` members. ``auto`` (default) engages
+    it only when the world is larger than one leader group — small
+    worlds keep today's flat protocol byte-for-byte."""
+    val = (get(HIER_NEGOTIATION, "auto") or "auto").strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return world_size > 1
+    if val in ("0", "false", "no", "off"):
+        return False
+    return world_size > negotiation_group_size()
 
 
 def donation_effective(platform: str) -> bool:

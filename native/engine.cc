@@ -122,6 +122,8 @@ class ResponseCache {
     bits_dirty_ = true;
   }
 
+  bool has(const std::string& name) const { return index_.count(name) != 0; }
+
   /* Touch as most-recently-used. */
   void touch(const std::string& name) {
     auto it = index_.find(name);
@@ -598,6 +600,26 @@ class Engine {
     return static_cast<int32_t>(cache_.size());
   }
 
+  /* Whether `name` is currently held by the response cache. Invalidation
+   * is driven by the globally-ingested request stream (see ingest()), so
+   * every rank answers identically on the same cycle — the coordinator
+   * ResponseCache (engine_service) gates its local serving on this to
+   * stay coherent with the protocol-level cache. */
+  int32_t cache_has(const char* name) {
+    std::lock_guard<std::mutex> lock(mu_);
+    return cache_.has(name) ? 1 : 0;
+  }
+
+  /* Whether any rank is currently JOINed (its JOIN request ingested but
+   * not yet completed by every rank joining). While true, peers must not
+   * short-circuit negotiation from caches: the joined rank only learns
+   * about scheduled collectives (for its zero executions) from responses
+   * computed by a real round. */
+  int32_t join_pending() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return (join_pending_ || !joined_ranks_.empty()) ? 1 : 0;
+  }
+
   Timeline timeline;
 
  private:
@@ -944,6 +966,14 @@ int32_t hvd_engine_pending_count(hvd_engine_t engine) {
 
 int32_t hvd_engine_cache_size(hvd_engine_t engine) {
   return static_cast<hvd::Engine*>(engine)->cache_size();
+}
+
+int32_t hvd_engine_cache_has(hvd_engine_t engine, const char* name) {
+  return static_cast<hvd::Engine*>(engine)->cache_has(name);
+}
+
+int32_t hvd_engine_join_pending(hvd_engine_t engine) {
+  return static_cast<hvd::Engine*>(engine)->join_pending();
 }
 
 const char* hvd_core_version(void) { return "hvd_core 0.1.0"; }

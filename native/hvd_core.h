@@ -152,6 +152,11 @@ void hvd_timeline_record(hvd_engine_t engine, const char* tensor,
 
 int32_t hvd_engine_pending_count(hvd_engine_t engine);
 int32_t hvd_engine_cache_size(hvd_engine_t engine);
+/* 1 when `name` is held by the response cache (stream-driven invalidation
+ * keeps the answer identical on every rank per cycle). */
+int32_t hvd_engine_cache_has(hvd_engine_t engine, const char* name);
+/* 1 while any rank's JOIN is in flight (ingested, not yet completed). */
+int32_t hvd_engine_join_pending(hvd_engine_t engine);
 const char* hvd_core_version(void);
 
 #ifdef __cplusplus

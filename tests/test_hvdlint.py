@@ -720,6 +720,43 @@ class TestRankDivergence:
         found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
         assert found == []
 
+    def test_trips_on_leader_role_state(self, tmp_path):
+        # ISSUE 13: "am I a leader" is rank-local exactly like rank() —
+        # a collective conditioned on it hangs the member ranks
+        src = """
+            def leader_gated(h, layout, me):
+                if layout.is_leader(me):
+                    h.allreduce_async([1.0], name="agg")
+
+            def cached_role(h, transport, entry):
+                role = transport.is_leader
+                if role:
+                    h.flush_entry(entry)
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 2, msgs
+        assert "leader-role state" in msgs
+
+    def test_static_group_layout_shape_passes(self, tmp_path):
+        # the layout's rank-SYMMETRIC shape queries are pure functions
+        # of (world, G): every rank computes the same value — NOT flagged
+        src = """
+            from horovod_tpu.negotiation import GroupLayout
+
+            def per_group(h, world):
+                layout = GroupLayout(world, 8)
+                if layout.n_groups > 1:
+                    h.allreduce_async([1.0], name="per_group")
+                for g in range(layout.n_groups):
+                    h.allreduce_async([float(g)], name=f"g{g}")
+
+            def leader_as_value(h, layout, gid):
+                h.allreduce_async([1.0], name=f"lead.{layout.leader_of(gid)}")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
     def test_rank_symmetric_conditionals_pass(self, tmp_path):
         # every rank evaluates the same test the same way: no divergence
         src = """

@@ -59,6 +59,13 @@ _WALLCLOCK_LAST = {"monotonic", "perf_counter"}
 _RUNTIME_STATE_LAST = {"fusion_stats", "qos_stats",
                        "dispatch_cache_stats", "health_stats",
                        "metrics_dump", "straggler_stats"}
+# leader-role predicates (ISSUE 13, negotiation/layout.py): "am I a
+# leader" differs per rank exactly like rank() — a collective submission
+# conditioned on it is the same mismatched-collective hang. The static
+# layout's rank-SYMMETRIC shape queries (n_groups, leaders(),
+# members_of(g), leader_of(g) with a literal group) stay legal: every
+# rank computes the same value from the same (world, G).
+_LEADER_CALLS = {"is_leader", "is_group_leader", "leads"}
 _SUBMIT_NAMES = {"flush_entry", "negotiate_many_submit"}
 
 
@@ -76,6 +83,9 @@ def _taint_call(node: ast.AST) -> str | None:
         return f"{name}() (wall clock)"
     if last in _RUNTIME_STATE_LAST:
         return f"{name}() (dynamic queue/tenant runtime state)"
+    if last in _LEADER_CALLS:
+        return (f"{name}() (leader-role state: leadership is rank-local; "
+                "only the static group layout's shape is symmetric)")
     return None
 
 
@@ -84,6 +94,12 @@ def _expr_taint(expr: ast.AST, tainted: dict[str, str]) -> str | None:
         why = _taint_call(node)
         if why is not None:
             return why
+        if isinstance(node, ast.Attribute) and node.attr == "is_leader":
+            # bare `.is_leader` attribute read (a cached role flag);
+            # the call form `layout.is_leader(r)` is caught by
+            # _taint_call first (ast.walk visits the Call before its
+            # func attribute)
+            return f"{node.attr} (leader-role state)"
         if isinstance(node, ast.Name) and node.id in tainted:
             return tainted[node.id]
     return None

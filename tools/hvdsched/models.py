@@ -535,9 +535,118 @@ def loopback_exchange():
             raise AssertionError(f"rank {r} round 0 outcome {first!r}")
 
 
+def hier_negotiation():
+    """ISSUE-13: the two-level negotiation round (member posts to its
+    group, the leader aggregates, one cross-leader exchange, the agreed
+    response fans back down) rendezvousing over a cooperative KV, raced
+    by a LEADER-death task that poisons the world mid-round (the
+    watchdog's coordinated abort). World 3, G=2: groups [0,1] and [2],
+    leaders 0 and 2 — leader 2 is also a one-member group (the ragged
+    G∤world shape). Contract: every rank either returns its round's
+    fan-down table or raises the failure; no waiter parks forever, and
+    any completing rank's table carries every rank's frame. The waits
+    re-check their predicate ATOMICALLY under the condition — the exact
+    window the planted ``leader-lost-wakeup-demo`` leaves open."""
+    inv = _inv()
+    cv = inv.make_condition("hier.cv")
+    kv: dict = {}
+    failed: list = []
+    groups = {0: [0, 1], 1: [2]}
+    leader_of = {0: 0, 1: 2}
+    gid_of = {0: 0, 1: 0, 2: 1}
+
+    def put(key, val):
+        with cv:
+            kv[key] = val
+            cv.notify_all()
+
+    def wait_for(pred):
+        with cv:
+            while True:
+                got = pred()
+                if got is not None:
+                    return got
+                if failed:
+                    raise RuntimeError("watchdog: leader dead")
+                cv.wait(5.0)
+
+    outcomes: dict = {}
+
+    def rank(r):
+        gid = gid_of[r]
+        try:
+            put(("m", gid, r), f"frame{r}")
+            if leader_of[gid] == r:
+                members = groups[gid]
+                blob = wait_for(lambda: (
+                    {m: kv[("m", gid, m)] for m in members}
+                    if all(("m", gid, m) in kv for m in members)
+                    else None))
+                put(("x", gid), blob)
+                table = wait_for(lambda: (
+                    {r2: f for g in groups for r2, f in
+                     kv.get(("x", g), {}).items()}
+                    if all(("x", g) in kv for g in groups) else None))
+                put(("r", gid), table)
+                outcomes[r] = table
+            else:
+                outcomes[r] = wait_for(lambda: kv.get(("r", gid)))
+        except RuntimeError as e:
+            outcomes[r] = e
+
+    def leader_killer():
+        with cv:
+            failed.append(1)
+            cv.notify_all()
+
+    ts = [inv.spawn_thread(rank, name=f"rank-{r}", args=(r,))
+          for r in gid_of]
+    tk = inv.spawn_thread(leader_killer, name="leader-killer")
+    for t in ts:
+        inv.join_thread(t)
+    inv.join_thread(tk)
+    for r in gid_of:
+        if r not in outcomes:
+            raise AssertionError(f"rank {r} recorded no outcome")
+        out = outcomes[r]
+        if not isinstance(out, RuntimeError):
+            if sorted(out) != [0, 1, 2]:
+                raise AssertionError(
+                    f"rank {r} fan-down table incomplete: {out!r}")
+
+
 # ---------------------------------------------------------------------------
 # known-bad demos (exploration MUST find these)
 # ---------------------------------------------------------------------------
+
+
+def leader_lost_wakeup_demo():
+    """PLANTED leader-lost-wakeup (ISSUE-13): a member checks for its
+    group's fan-down response OUTSIDE the condition and only then parks
+    — a schedule where the leader publishes and notifies inside that
+    window loses the wakeup and the member waits for a notify that
+    already happened, exactly the bug class the real hierarchical
+    round's atomic check-and-wait (see ``hier_negotiation``) closes.
+    Most schedules pass; exploration must FIND the window and the
+    finding replays byte-for-byte from (seed, trace)."""
+    inv = _inv()
+    cv = inv.make_condition("hierdemo.cv")
+    kv: dict = {}
+
+    def leader():
+        with cv:
+            kv["r0"] = {"table": "agreed"}
+            cv.notify_all()
+
+    def member():
+        if "r0" not in kv:  # BUG: check and wait are not atomic
+            with cv:
+                cv.wait()
+
+    ts = [inv.spawn_thread(member, name="member"),
+          inv.spawn_thread(leader, name="leader")]
+    for t in ts:
+        inv.join_thread(t)
 
 
 def loopback_exchange_unguarded():
@@ -665,6 +774,7 @@ MATRIX = {
     "watchdog-abort": watchdog_poison_abort,
     "capture-replay-abort": capture_replay_abort,
     "qos-admission": qos_admission,
+    "hier-negotiation": hier_negotiation,
     "loopback-exchange": loopback_exchange,
     "pr3-issue-lock": pr3_issue_lock,
     "pr6-chain-guard": pr6_chain_guard,
@@ -674,6 +784,7 @@ DEMOS = {
     "deadlock-demo": deadlock_demo,
     "lost-wakeup-demo": lost_wakeup_demo,
     "loopback-exchange-unguarded": loopback_exchange_unguarded,
+    "leader-lost-wakeup-demo": leader_lost_wakeup_demo,
     "qos-inversion-demo": qos_inversion_demo,
     "pr3-unguarded": pr3_unguarded,
     "pr6-unguarded": pr6_unguarded,
