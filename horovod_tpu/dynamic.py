@@ -175,6 +175,36 @@ def parse_responses(data: bytes) -> list[Response]:
     return out
 
 
+def parse_requests(data: bytes) -> list[dict]:
+    """Parse one member's serialized request list (the Python twin of
+    ``native/message.h`` ``RequestList::parse``). The coordinator
+    ResponseCache's join-race detector scans exchanged frames for JOIN
+    requests to name the joining rank (docs/negotiation.md); keys:
+    ``rank``, ``request_type``, ``name``."""
+    if not data:
+        return []
+    r = _Reader(data)
+    out = []
+    for _ in range(r.u32()):
+        rank = r.i32()
+        rtype = r.u8()
+        r.i32()  # dtype
+        r.i32()  # element_size
+        r.i32()  # root_rank
+        r.i32()  # group_id
+        name = r.str()
+        for _ in range(r.u32()):  # shape
+            r.i64()
+        for _ in range(r.u32()):  # splits
+            r.i32()
+        r.i32()  # reduce_op
+        r.f64()  # prescale
+        r.f64()  # postscale
+        r.i32()  # splits_crc
+        out.append({"rank": rank, "request_type": rtype, "name": name})
+    return out
+
+
 def parse_stall_report(data: bytes) -> list[StallEntry]:
     r = _Reader(data)
     out = []

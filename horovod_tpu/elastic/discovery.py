@@ -252,13 +252,146 @@ class HostDiscoveryScript(HostDiscovery):
 
 class FixedHosts(HostDiscovery):
     """Static (but settable) host set — the unit-test hook (reference
-    ``FixedHosts``, used by ``test_elastic_driver.py``)."""
+    ``FixedHosts``, used by ``test_elastic_driver.py``) and the substrate
+    scripted churn mutates (:class:`ScriptedChurn`)."""
 
     def __init__(self, host_slots: dict[str, int]):
+        self._mu = threading.Lock()
         self._host_slots = dict(host_slots)
 
     def find_available_hosts_and_slots(self) -> dict[str, int]:
-        return dict(self._host_slots)
+        with self._mu:
+            return dict(self._host_slots)
 
     def set(self, host_slots: dict[str, int]) -> None:
-        self._host_slots = dict(host_slots)
+        with self._mu:
+            self._host_slots = dict(host_slots)
+
+    def add_hosts(self, host_slots: dict[str, int]) -> None:
+        """Grow the discovered set (scripted scale-up)."""
+        with self._mu:
+            self._host_slots.update(host_slots)
+
+    def remove_host(self, host: str) -> bool:
+        """Shrink the discovered set (scripted reclaim/preemption).
+        Returns whether the host was present."""
+        with self._mu:
+            return self._host_slots.pop(host, None) is not None
+
+
+class ScriptedChurn:
+    """The ``HVD_FAULT_SPEC`` membership-action handler (docs/elastic.md):
+    turns ``worker:add/remove/preempt`` rules fired at a rank's commit
+    boundary into discovery-set mutations on a :class:`FixedHosts`, so
+    spot/preemptible churn is a seeded, replayable schedule.
+
+    * ``add``: ``count`` fresh hosts (``churn0``, ``churn1``, ...) join
+      the discovered set; the driver grows the world at its next poll.
+    * ``remove``: the firing rank's host leaves the set; the driver
+      reclaims its worker abruptly when the round re-forms (spot
+      reclaim with no warning).
+    * ``preempt``: SIGTERM-style departure — the firing rank drains its
+      in-flight flushes at the commit boundary (its state is committed
+      by the time the interrupt lands), the driver is told to give the
+      host ``grace`` seconds to exit through the clean slot-lost path
+      instead of terminating it mid-collective, and only then does the
+      host leave the set. Survivors interrupt at the same commit via
+      the rank-0 broadcast, so a graceful preemption loses zero steps.
+
+    Installed by ``loopback.elastic_run`` via
+    ``faults.set_membership_handler``; runs on the firing rank's thread.
+    """
+
+    def __init__(self, hosts: FixedHosts, *, slots_per_host: int = 1,
+                 host_prefix: str = "churn", events: list | None = None):
+        from ..utils import invariants as _inv
+        self._hosts = hosts
+        self._slots = int(slots_per_host)
+        self._prefix = host_prefix
+        self._driver = None
+        self._added = 0
+        self._mu = _inv.make_lock("elastic.churn.mu")
+        # (monotonic seconds, action, host) — the bench/test event log
+        # (callers may inject their own list to read it after the run)
+        self.events: list[tuple[float, str, str | None]] = \
+            events if events is not None else []
+
+    def attach_driver(self, driver) -> None:
+        self._driver = driver
+
+    def _my_host(self) -> str | None:
+        from ..utils import envs
+        return envs.get(envs.HOSTNAME)
+
+    def __call__(self, action: str, rule) -> None:
+        import time as _time
+        from .. import metrics as _metrics
+        from ..utils import logging as hvd_logging
+        host = self._my_host()
+        if action == "add":
+            with self._mu:
+                fresh = {f"{self._prefix}{self._added + i}": self._slots
+                         for i in range(rule.count)}
+                self._added += rule.count
+            self._hosts.add_hosts(fresh)
+            hvd_logging.info("scripted churn: +%d host(s) %s",
+                             rule.count, sorted(fresh))
+            host = ",".join(sorted(fresh))
+        elif action == "remove":
+            if host is None:
+                hvd_logging.warning(
+                    "scripted churn: remove fired with no HVD_HOSTNAME")
+                return
+            self._hosts.remove_host(host)
+            hvd_logging.info("scripted churn: -host %s (abrupt)", host)
+        elif action == "preempt":
+            if host is None:
+                hvd_logging.warning(
+                    "scripted churn: preempt fired with no HVD_HOSTNAME")
+                return
+            # Drain this rank's in-flight flushes BEFORE the host leaves
+            # discovery: the departing rank's queued collectives land,
+            # its state is committed (we run inside commit()), and the
+            # driver's grace window lets it exit slot-lost instead of
+            # being torn down mid-collective — the 0-steps-lost contract.
+            from ..ops import fusion_cycle
+            try:
+                fusion_cycle.flush_all("preempt-drain")
+            except Exception:
+                hvd_logging.exception(
+                    "scripted churn: preempt drain failed; continuing")
+            if self._driver is not None:
+                self._driver.set_stale_grace(host, rule.grace_s)
+            self._hosts.remove_host(host)
+            hvd_logging.info("scripted churn: -host %s (preempt, grace %.1fs)",
+                             host, rule.grace_s)
+        else:  # pragma: no cover - grammar rejects unknown actions
+            return
+        with self._mu:
+            self.events.append((_time.monotonic(), action, host))
+        _metrics.ELASTIC_EVENTS.inc(labels={"kind": action})
+
+
+def install_scripted_churn(discovery, *, events: list | None = None,
+                           warn: bool = False):
+    """Wire ``HVD_FAULT_SPEC`` membership rules to ``discovery``: when the
+    spec schedules ``worker:add/remove/preempt`` and the discovery set is
+    mutable (:class:`FixedHosts`), install a :class:`ScriptedChurn` as the
+    process membership handler and return it — the caller must
+    ``attach_driver()`` once the driver exists and
+    ``faults.clear_membership_handler()`` on teardown. Returns ``None``
+    (optionally warning) when no rules are scheduled or the discovery
+    source cannot be mutated."""
+    from ..utils import faults as _faults
+    if not _faults.has_membership_rules():
+        return None
+    if discovery is None or not hasattr(discovery, "add_hosts"):
+        if warn:
+            hvd_logging.warning(
+                "HVD_FAULT_SPEC schedules membership churn but the "
+                "discovery source is not mutable (FixedHosts); membership "
+                "rules will no-op")
+        return None
+    churn = ScriptedChurn(discovery, events=events)
+    _faults.set_membership_handler(churn)
+    return churn

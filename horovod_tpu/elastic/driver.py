@@ -178,6 +178,12 @@ class ElasticDriver:
         self._world_size = 0
 
         self._wait_hosts_cond = threading.Condition()
+        # host -> grace seconds for the NEXT time its worker goes stale
+        # (scripted preemption: the departing worker drains + exits via
+        # the slot-lost path inside the window instead of being torn
+        # down mid-collective). HVD_ELASTIC_GRACE is the default for
+        # hosts without an explicit entry (0 = today's immediate kill).
+        self._stale_grace: dict[str, float] = {}
         # Serializes round transitions: _activate_workers can be entered from
         # the discovery thread (host change) and from worker-exit waiter
         # threads (registry resume) concurrently; rounds must be atomic.
@@ -214,7 +220,15 @@ class ElasticDriver:
         self._activate_workers(np)
 
     def resume(self) -> None:
-        """Start a new round after failures/blacklisting (registry hook)."""
+        """Start a new round after failures/blacklisting (registry hook).
+        A late failure record landing after the job already stopped
+        (e.g. a scripted-churn host's watchdog report racing success
+        teardown) must not resurrect the round machinery — resuming a
+        shut-down job raised from wait_for_available_slots and turned a
+        finished job into an error."""
+        if self._shutdown.is_set():
+            hvd_logging.debug("ignoring resume after shutdown")
+            return
         self._activate_workers(self._min_np)
 
     def stop(self, error_message: str | None = None,
@@ -493,14 +507,49 @@ class ElasticDriver:
         with self._proc_lock:
             return list(self._active_procs.keys())
 
+    def set_stale_grace(self, host: str, grace_s: float) -> None:
+        """Grant ``host``'s worker a clean-exit window the next time its
+        slot disappears (graceful preemption, docs/elastic.md): the
+        worker keeps participating until the host-change interrupt lands
+        at its commit boundary and then self-exits slot-lost — so a
+        scheduled departure loses zero steps instead of the abrupt
+        mid-collective kill's <=1."""
+        self._stale_grace[host] = float(grace_s)
+
     def _stop_stale_workers(self, stale_keys) -> None:
         for key in stale_keys:
             with self._proc_lock:
                 proc = self._active_procs.get(key)
-            if proc is not None and proc.poll() is None:
+            if proc is None or proc.poll() is not None:
+                continue
+            grace = self._stale_grace.pop(
+                key[0], envs.get_float(envs.ELASTIC_GRACE, 0.0))
+            if grace <= 0:
                 hvd_logging.info("terminating worker %s[%d]: slot removed",
                                  *key)
                 proc.terminate()
+                continue
+            hvd_logging.info(
+                "worker %s[%d] slot removed; granting %.1fs to exit "
+                "cleanly (preemption grace)", key[0], key[1], grace)
+
+            def deferred(proc=proc, key=key, grace=grace):
+                for _ in _retry.poll_intervals("elastic.stale-grace",
+                                               interval_s=0.2,
+                                               deadline_s=grace):
+                    if proc.poll() is not None or self._shutdown.is_set():
+                        return
+                if proc.poll() is None:
+                    hvd_logging.warning(
+                        "worker %s[%d] did not exit within its %.1fs "
+                        "preemption grace; terminating", key[0], key[1],
+                        grace)
+                    proc.terminate()
+
+            t = threading.Thread(target=deferred, daemon=True,
+                                 name=f"hvd-elastic-grace-{key[0]}")
+            t.start()
+            self._result_threads.append(t)
 
     def _start_worker_processes(self, pending_slots) -> None:
         spec_round = self._rendezvous.round_id
@@ -510,14 +559,34 @@ class ElasticDriver:
                              slot_info.rank, spec_round)
             self._start_worker_process(slot_info, spec_round)
 
-    def record_peer_failure(self, dead_rank: int, reason: str) -> None:
+    def record_peer_failure(self, dead_rank: int, reason: str,
+                            round_id: int = -1) -> None:
         """A surviving worker's health watchdog reported ``dead_rank``
         dead (poison/beat-timeout record on the launcher KV, parsed by
         the bootstrap PUT observer): convert the coordinated abort into
         a registry failure so the dead host is blacklisted and
         :meth:`resume` re-forms the round NOW — without waiting for the
-        dead process to be reaped by its exit waiter."""
+        dead process to be reaped by its exit waiter.
+
+        ``round_id`` is the round the REPORTER was in. Global ranks
+        renumber every round, so a report from a superseded round must
+        be resolved against THAT round's slot table — resolving it
+        against the newest one can blacklist an innocent replacement
+        worker that inherited the dead rank's number (seen under
+        scripted churn: a removed host's watchdog-detected death arrived
+        after its slot had already been reassigned)."""
         slot = self._rank_assignments.get(dead_rank)
+        current_round = self._rendezvous.round_id
+        if round_id >= 0 and round_id != current_round:
+            stale_slot = self._slot_in_round(round_id, dead_rank)
+            if stale_slot is None or slot is None \
+                    or stale_slot.hostname != slot.hostname:
+                hvd_logging.info(
+                    "ignoring stale peer-failure report for rank %d of "
+                    "round %d (now round %d): %s — the host already left "
+                    "the assignment", dead_rank, round_id, current_round,
+                    reason)
+                return
         if slot is None:
             hvd_logging.warning(
                 "peer-failure report for unassigned rank %d (%s); ignoring",
@@ -536,6 +605,21 @@ class ElasticDriver:
             daemon=True, name=f"hvd-elastic-peerfail-{dead_rank}")
         t.start()
         self._result_threads.append(t)
+
+    def _slot_in_round(self, round_id: int, rank: int):
+        """Slot assignment of ``rank`` in a (possibly superseded) round,
+        from the published round spec; None when unknown."""
+        try:
+            raw = self._rendezvous.kv.get(ROUND_SPEC_KEY.format(round_id))
+            if raw is None:
+                return None
+            spec = pickle.loads(raw)
+            for s in spec["slots"]:
+                if s["rank"] == rank:
+                    return slot_from_dict(s)
+        except Exception as e:
+            hvd_logging.debug("round-%d spec lookup failed: %s", round_id, e)
+        return None
 
     def _start_worker_process(self, slot_info, spec_round: int) -> None:
         try:

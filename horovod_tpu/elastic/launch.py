@@ -56,6 +56,7 @@ def run_elastic(args, command: list[str]) -> int:
         extra_base["HVD_METRICS_PORT"] = str(args.metrics_port)
 
     lb_world = None
+    churn = None
     if getattr(args, "loopback", False):
         # Elastic over rank THREADS: same driver/registry/rendezvous,
         # loopback spawner (docs/loopback.md).
@@ -68,6 +69,14 @@ def run_elastic(args, command: list[str]) -> int:
             kv_addr="127.0.0.1", kv_port=infra.kv_port, secret=infra.secret)
         lb_body, lb_argv = lb_engine.script_body(command)
         _sys.argv = lb_argv
+        # Scripted churn (docs/elastic.md): membership rules in
+        # HVD_FAULT_SPEC drive the discovery set. Loopback only — the
+        # handler fires on a worker's commit and must share the
+        # driver's process to mutate its discovery.
+        from .discovery import install_scripted_churn
+        churn = install_scripted_churn(discovery)
+        if churn is not None:
+            churn.attach_driver(driver)
 
     def create_worker_fn(slot_info: hosts_mod.SlotInfo, spec_round: int):
         spec = infra.round_spec(spec_round)
@@ -95,6 +104,9 @@ def run_elastic(args, command: list[str]) -> int:
         driver.join()
         results = driver.get_results()
     finally:
+        if churn is not None:
+            from ..utils import faults as _faults
+            _faults.clear_membership_handler()
         infra.stop()
         if lb_world is not None:
             lb_world.shutdown()

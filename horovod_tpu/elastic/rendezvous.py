@@ -82,6 +82,13 @@ class WorkerRendezvous:
             hvd_logging.info(
                 "slot %s[%d] not assigned in round %d; exiting",
                 self.hostname, self.slot, spec["round"])
+            # Graceful departure: announce it on the health channel so
+            # surviving watchdogs skip this rank's ceased beats instead
+            # of reading the clean exit as a death (a preempted worker's
+            # exit raced slow survivors into a spurious failure
+            # recovery; docs/elastic.md).
+            from .. import engine_service
+            engine_service.mark_leaving()
             sys.exit(SLOT_LOST_EXIT_CODE)
         self._reinitialize(spec, my_slot)
 
@@ -201,6 +208,10 @@ class WorkerRendezvous:
             envs.NUM_PROCESSES: spec["world_size"],
             envs.COORDINATOR_ADDR: spec["coord_addr"],
             envs.COORDINATOR_PORT: spec["coord_port"],
+            # The round this worker now runs in: HVD_FAULT_SPEC at_round
+            # filters and at_round-keyed churn schedules read it — the
+            # spawn-time seed alone would go stale on the first re-form.
+            envs.ELASTIC_ROUND: spec["round"],
         }
         for name, value in env.items():
             envs.set_env(name, value)

@@ -199,7 +199,13 @@ class JaxState(ObjectState):
 
 def run_fn(func, reset):
     """Wrap ``func(state, ...)`` in the elastic recover loop (reference
-    ``run_fn``, ``elastic.py:151-174``)."""
+    ``run_fn``, ``elastic.py:151-174``). Each recovery is measured
+    (docs/elastic.md SLOs): the re-form duration histogram spans
+    catch -> re-rendezvous -> state re-sync, events are counted by kind,
+    and a failure restore counts its rolled-back in-flight step."""
+    import time as _time
+
+    from .. import metrics as _metrics
     from .notification import get_notification_manager
 
     @functools.wraps(func)
@@ -208,18 +214,55 @@ def run_fn(func, reset):
         notification_manager.init()
         notification_manager.register_listener(state)
         skip_sync = False
+        t0 = None  # start of the recovery in flight (None = training)
         try:
             while True:
                 try:
+                    # The post-reset re-sync runs at the loop top INSIDE
+                    # this try: a second failure landing during the
+                    # rank-0 broadcast (overlapping churn — exactly the
+                    # window scripted schedules create) must start
+                    # another recovery round, never escape the loop.
                     if not skip_sync:
                         state.sync()
+                    if t0 is not None:
+                        _metrics.ELASTIC_REFORM_SECONDS.observe(
+                            _time.monotonic() - t0)
+                        t0 = None
                     return func(state, *args, **kwargs)
                 except HorovodInternalError:
+                    first = t0 is None
+                    if first:
+                        t0 = _time.monotonic()
+                    kind = "peer-failure"
                     state.restore()
                     skip_sync = False
+                    # Commit-per-step convention: the step in flight when
+                    # the failure landed rolls back to the last commit.
+                    # (Commit-every-N loops lose up to N; the elastic
+                    # bench measures the exact count from its step log.)
+                    # A double-fault caught during the re-sync itself had
+                    # no step in flight — only the first catch counts.
+                    if first:
+                        _metrics.ELASTIC_STEPS_LOST.inc()
                 except HostsUpdatedInterrupt as e:
+                    if t0 is None:
+                        t0 = _time.monotonic()
+                    kind = "hosts-updated"
                     skip_sync = e.skip_sync
+                    if skip_sync:
+                        # Removal-only re-form: the rank-0 broadcast is
+                        # skipped (survivors already hold identical
+                        # state) — but live attrs may be DEVICE arrays
+                        # produced by the departing world's mesh, which
+                        # the re-formed world's programs reject
+                        # ("incompatible devices"). restore() re-sets
+                        # them from the just-committed host copies —
+                        # value-identical, since the interrupt fires
+                        # inside commit() right after save().
+                        state.restore()
 
+                _metrics.ELASTIC_EVENTS.inc(labels={"kind": kind})
                 reset()
                 state.on_reset()
         finally:

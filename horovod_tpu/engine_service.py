@@ -33,11 +33,14 @@ from .loopback import context as _lbctx
 from .negotiation import response_cache as _rcache
 from .utils import invariants as _inv
 from .dynamic import (
+    REQ_JOIN,
     HorovodCollectiveError,
     NativeEngine,
     Response,
     and_bitvectors,
+    parse_requests,
 )
+from .exceptions import ResponseCacheJoinError
 from .utils import envs
 from .utils import faults as _faults
 from .utils import logging as hvd_logging
@@ -192,6 +195,43 @@ class DynamicService:
         self._rcache = (_rcache.ResponseCache(cap, pset_key)
                         if cap > 0 else None)
         self._rc_epoch = envs.override_epoch()
+        # Batches served locally since the previous negotiation cycle —
+        # the join-race detection window (see _check_join_race).
+        self._rc_serves_window = 0
+        # Elastic warm re-form (docs/elastic.md): adopt the same-shape
+        # predecessor's shelved entries as WARM (unserveable), publish
+        # this rank's warm-content digest, and resolve on the first
+        # cycle: all-equal digests re-arm the cache after one
+        # confirmation round; any disagreement (fresh member, divergent
+        # shelf) drops the warm set and takes the cold two-round path.
+        self._rc_warm_pending = False
+        _ctx = _lbctx.current()
+        self._rc_shape_key = (
+            _ctx.world.name if _ctx is not None else "proc",
+            pset_key, getattr(transport, "world_size", 1),
+            getattr(transport, "rank", 0))
+        if (self._rcache is not None and envs.elastic_warm_enabled()
+                and getattr(transport, "kv", None) is not None
+                and getattr(transport, "prefix", None) is not None):
+            shelved = _rcache.take_shelved(self._rc_shape_key)
+            if shelved:
+                n = self._rcache.restore_warm(shelved)
+                hvd_logging.info(
+                    "response cache: restored %d warm entries for shape "
+                    "%s", n, self._rc_shape_key)
+            try:
+                transport.kv.put(
+                    f"{transport.prefix}/warm/{transport.rank}",
+                    self._rcache.warm_digest())
+                # Publish unconditionally (peers' gathers need every
+                # member's digest — an empty marker is the veto) but only
+                # GATHER when this rank actually holds warm entries.
+                self._rc_warm_pending = self._rcache.warm_count() > 0
+            except Exception as e:
+                hvd_logging.warning(
+                    "response cache: warm digest publish failed (%s); "
+                    "cold re-form", e)
+                self._rcache.drop_warm()
         # Latched once any JOIN is observed: a joined rank only learns
         # of scheduled collectives (for its zero executions) from real
         # rounds, and a peer's locally-served uneven tail would starve
@@ -474,9 +514,28 @@ class DynamicService:
                         pass  # engine may already be torn down
 
     def stop(self):
+        # Elastic warm re-form: a GRACEFULLY stopping service (re-form
+        # teardown — no failure recorded) shelves its coordinator-cache
+        # entries under its shape key; the same-shape successor restores
+        # them warm. A service failed by a coordinated abort already
+        # invalidated its cache — a broken world's coherence proof must
+        # not carry over.
+        if (self._rcache is not None and self._failure is None
+                and envs.elastic_warm_enabled()):
+            items = self._rcache.export_entries()
+            if items:
+                _rcache.shelve(self._rc_shape_key, items)
         self._shutdown.set()
         self._tick.set()  # the adaptive sleep waits on _tick, not _shutdown
         if self._watchdog is not None:
+            # A stop() is a DELIBERATE departure from this service's
+            # health channel (re-form teardown, slot-lost exit, job
+            # end): publish the leave marker BEFORE beats cease, so a
+            # peer still watching the old channel (ranks re-initialize
+            # at different speeds) never reads the silence as a death.
+            # Abrupt paths (_abrupt_stop, crash) never come through
+            # here — real deaths stay detectable.
+            self._watchdog.mark_leaving()
             self._watchdog.stop()
         # Short join: a cycle thread parked in the KV gather long-poll
         # (waiting for peers that are also shutting down) can take the
@@ -532,6 +591,14 @@ class DynamicService:
         with self._mu:
             if self._failure:
                 raise self._failure_error()
+            # Join-latch re-check under the SAME lock the cycle thread
+            # latches under (_check_join_race): a serve racing the latch
+            # either observes it here (and takes the real path) or lands
+            # its window increment before the cycle's read — so a
+            # pre-join-latch serve is always either prevented or
+            # DETECTED, never silently unpaired.
+            if self._rc_join_latch:
+                return None
             for req in requests:
                 # Same deterministic duplicate-name contract as the full
                 # path: a name still registered by an in-flight REAL
@@ -542,6 +609,7 @@ class DynamicService:
                     raise DuplicateNameError(
                         f"tensor name {req['name']!r} is already being "
                         "negotiated; pass a unique name=")
+            self._rc_serves_window += 1
         pends = []
         for resp in responses:
             pend = _Pending()
@@ -644,6 +712,8 @@ class DynamicService:
         # computed against the PRE-ingest cache state on every member (so
         # bit positions agree), the AND-served set commits first, and
         # ingest then skips served names — one KV round per cycle.
+        if self._rc_warm_pending:
+            self._resolve_warm()
         with self._mu:
             busy = bool(self._pending)
         mine = self.engine.pop_requests()
@@ -654,6 +724,7 @@ class DynamicService:
                                                self._exchange_timeout)
         if busy:
             self._record_round_metrics()
+        self._check_join_race(datas)
         self.engine.commit_cache_bits(and_bitvectors(bitvs))
         for rank, data in enumerate(datas):
             self.engine.ingest(rank, data)
@@ -665,6 +736,93 @@ class DynamicService:
         if now - self._last_stall_check > _STALL_CHECK_INTERVAL_S:
             self._last_stall_check = now
             self._check_stalls()
+
+    def _resolve_warm(self) -> None:
+        """One-time warm-digest resolution (docs/elastic.md): every
+        member published its warm-content digest at service start; all
+        equal and non-empty means every member restored the identical
+        shelved entries, so warm entries flip to confirmed on every rank
+        at this same pre-serving point — local serving then resumes
+        after ONE real round per name (the native-cache gate), instead
+        of the cold populate+confirm two. Any disagreement — a fresh
+        replacement rank publishes the empty marker — or a gather
+        failure drops the warm set everywhere."""
+        self._rc_warm_pending = False
+        rc = self._rcache
+        transport = self.transport
+        if rc is None:
+            return
+        try:
+            got = transport.kv.gather(f"{transport.prefix}/warm",
+                                      transport.world_size,
+                                      timeout=self._exchange_timeout)
+            digests = set(got.values())
+            mine = rc.warm_digest()
+        except Exception as e:
+            dropped = rc.drop_warm()
+            if dropped:
+                hvd_logging.warning(
+                    "response cache: warm digest exchange failed (%s); "
+                    "dropped %d warm entries (cold re-form)", e, dropped)
+            return
+        if len(digests) == 1 and mine in digests and mine != b"\x00" * 8:
+            n = rc.confirm_warm()
+            if n:
+                _metrics.ELASTIC_WARM_REUSE.inc(
+                    n, labels={"kind": "response"})
+                hvd_logging.info(
+                    "response cache: %d warm entries confirmed after one "
+                    "digest round (shape %s)", n, self._rc_shape_key)
+        else:
+            dropped = rc.drop_warm()
+            if dropped:
+                hvd_logging.info(
+                    "response cache: warm digests diverge (fresh member "
+                    "or different shelf); dropped %d entries (cold "
+                    "re-form)", dropped)
+
+    def _check_join_race(self, datas) -> None:
+        """Coordinator-side join-latch race detection (ROADMAP protocol
+        follow-on (a)): the cycle that first observes a peer's JOIN
+        latches local serving off — and if any batch was served locally
+        in the window since the previous cycle (a decision made without
+        knowledge of the join), those collectives were never scheduled
+        through a real round and the joined rank can never pair them.
+        Surface that as a typed :class:`ResponseCacheJoinError` naming
+        the joining rank NOW instead of letting the unpaired work burn
+        the full exchange deadline."""
+        if self._rcache is None or self._rc_join_latch:
+            return
+        joiner = -1
+        found = False
+        for data in datas:
+            if not data:
+                continue
+            try:
+                reqs = parse_requests(data)
+            except Exception:  # hvdlint: disable=silent-except
+                continue  # corrupt frame: ingest will raise the real error
+            for req in reqs:
+                if req["request_type"] == REQ_JOIN:
+                    joiner = req["rank"]
+                    found = True
+                    break
+            if found:
+                break
+        with self._mu:
+            served = self._rc_serves_window
+            self._rc_serves_window = 0  # new cycle, new window
+            if found:
+                self._rc_join_latch = True
+        if found and served:
+            gr = self._straggler.global_ranks
+            exc = ResponseCacheJoinError(
+                gr[joiner] if 0 <= joiner < len(gr) else joiner, served)
+            hvd_logging.error("%s", exc)
+            _timeline.record_health_event("RC_JOIN_RACE")
+            self._fail_all(str(exc), exc)
+            self._shutdown.set()
+            self._tick.set()
 
     def _record_round_metrics(self) -> None:
         """Registry samples for one BUSY negotiation round (local work
@@ -895,6 +1053,19 @@ def response_cache_stats() -> dict:
         if stats is not None:
             out["global" if key == "0" else key] = stats
     return out
+
+
+def mark_leaving() -> None:
+    """Announce this world's GRACEFUL departure on every service's
+    health channel (elastic slot-lost exit, docs/elastic.md): peers'
+    silence detection then skips this rank's ceased beats."""
+    scope = _ServiceScope()
+    with _service_lock:
+        svcs = list(scope.table.values())
+    for svc in svcs:
+        wd = svc.health_watchdog()
+        if wd is not None:
+            wd.mark_leaving()
 
 
 def reset_service() -> None:

@@ -43,6 +43,36 @@ class PeerFailureError(HorovodInternalError):
             f"peer rank {rank} failed: {reason}{owed}")
 
 
+class ResponseCacheJoinError(HorovodInternalError):
+    """The coordinator ResponseCache served a batch locally while a
+    peer's JOIN was racing the join latch (``HVD_RESPONSE_CACHE``;
+    docs/negotiation.md "Joins"): the locally-served collectives were
+    never scheduled through a real round, so the joining rank can never
+    contribute its zero executions and the work would otherwise hang
+    until the full exchange deadline. The coordinator detects the race
+    on the cycle that first observes the JOIN and fails fast with this
+    typed error naming the joining rank.
+
+    Subclasses :class:`HorovodInternalError`: the world is healthy but
+    this service's serving decisions diverged — elastic mode restores
+    committed state and re-forms, and non-elastic callers get a precise
+    error in seconds instead of a deadline timeout.
+    """
+
+    def __init__(self, joining_rank: int, served_batches: int):
+        self.joining_rank = joining_rank
+        self.served_batches = served_batches
+        who = (f"rank {joining_rank}" if joining_rank >= 0
+               else "an unidentified rank")
+        super().__init__(
+            f"coordinator ResponseCache served {served_batches} batch(es) "
+            f"locally while {who}'s JOIN was in flight (pre-join-latch "
+            "window); the served collectives cannot pair with the joined "
+            "rank — re-negotiate (elastic mode re-forms automatically). "
+            "Keep HVD_RESPONSE_CACHE off for join-terminated workloads "
+            "(docs/negotiation.md).")
+
+
 class QosAdmissionError(RuntimeError):
     """An async collective submission was shed at enqueue by its
     tenant's QoS admission control (``hvd.set_qos(...,

@@ -59,14 +59,20 @@ def peer_failure_key(reporter_rank: int) -> str:
 
 
 def parse_peer_failure(key: str, payload: bytes):
-    """``(dead_rank, reason)`` if ``key`` records a peer failure, else
-    None (malformed records are ignored — the process-exit path still
-    catches the failure)."""
+    """``(dead_rank, reason, round_id)`` if ``key`` records a peer
+    failure, else None (malformed records are ignored — the process-exit
+    path still catches the failure). ``round_id`` is the elastic round
+    the REPORTER was in (-1 for legacy records): global ranks renumber
+    every round, so the driver must resolve the rank against the
+    reporter's round, not whatever round is newest — a stale report
+    about a just-replaced rank must never blacklist its innocent
+    successor (docs/elastic.md)."""
     if not key.startswith(PEER_FAILURE_KEY_PREFIX):
         return None
     try:
         body = json.loads(payload.decode())
-        return int(body["dead_rank"]), str(body.get("reason", ""))
+        return (int(body["dead_rank"]), str(body.get("reason", "")),
+                int(body.get("round", -1)))
     except (ValueError, KeyError, UnicodeDecodeError):
         return None
 
@@ -131,6 +137,12 @@ class HealthWatchdog:
         # that dies before ever beating is still covered by the stall
         # inspector / exchange deadline, exactly as before this PR.
         self._seen: dict[int, tuple[int | None, float | None]] = {}
+        # Local ranks that announced a GRACEFUL departure (an elastic
+        # slot-lost exit publishes a `left/<rank>` marker): their beats
+        # legitimately cease, so silence detection skips them — without
+        # the marker, a preempted worker's clean exit raced the
+        # survivors' re-rendezvous and read as a death (docs/elastic.md).
+        self._left: set[int] = set()
         self._failed: tuple[int, str] | None = None
         # Through the invariants constructors so both the lock-order
         # witness (HVD_DEBUG_INVARIANTS) and the hvdsched cooperative
@@ -183,6 +195,32 @@ class HealthWatchdog:
         except Exception as e:
             hvd_logging.warning("health: poison publish failed: %s", e)
 
+    def mark_leaving(self) -> None:
+        """Announce a GRACEFUL departure (elastic slot-lost exit): this
+        rank's beats are about to cease on purpose. Peers' silence
+        detection skips marked ranks — a preempted worker's clean exit
+        must never read as a death to a survivor that hasn't
+        re-rendezvoused yet."""
+        try:
+            self.kv.put(f"{self.prefix}/left/{self.rank}", b"1")
+        except Exception as e:
+            hvd_logging.warning("health: leave marker publish failed: %s",
+                                e)
+
+    def _check_left(self) -> None:
+        """Fold newly-announced graceful departures into ``_left`` (one
+        key listing per tick, the `_check_poison` pattern)."""
+        try:
+            names = self.kv.keys(f"{self.prefix}/left")
+        except Exception:
+            return  # KV flap: skip this tick's update
+        marker = f"{self.prefix}/left/"
+        for key in names:
+            try:
+                self._left.add(int(key[len(marker):]))
+            except ValueError:
+                continue
+
     def report_peer_failure(self, dead_rank: int, reason: str) -> None:
         """Elastic conversion: record the death on the launcher KV so the
         driver blacklists the dead host without waiting for process
@@ -191,7 +229,10 @@ class HealthWatchdog:
             return
         try:
             self.kv.put(peer_failure_key(self.rank), json.dumps(
-                {"dead_rank": dead_rank, "reason": reason}).encode())
+                {"dead_rank": dead_rank, "reason": reason,
+                 # the reporter's round: ranks renumber per round, so the
+                 # driver resolves dead_rank against THIS round's table
+                 "round": envs.get_int(envs.ELASTIC_ROUND, -1)}).encode())
         except Exception as e:
             hvd_logging.warning(
                 "health: peer-failure publish failed: %s", e)
@@ -199,29 +240,44 @@ class HealthWatchdog:
     # -- monitor loop ------------------------------------------------------
 
     def _loop(self) -> None:
+        decided = False
         while not self._stop.is_set():
             self._publish_beat()
-            dead = self._check_peers()
-            if dead is not None:
-                local_rank, reason = dead
-                rank = self.global_ranks[local_rank]  # outward-facing
-                with self._mu:
-                    already = self._failed is not None
+            if not decided:
+                dead = self._check_peers()
+                if dead is not None:
+                    local_rank, reason = dead
+                    rank = self.global_ranks[local_rank]  # outward-facing
+                    with self._mu:
+                        already = self._failed is not None
+                        if not already:
+                            self._failed = (rank, reason)
                     if not already:
-                        self._failed = (rank, reason)
-                if not already:
-                    _metrics.HEALTH_PEER_FAILURES.inc(
-                        labels={"rank": rank})
-                    hvd_logging.error(
-                        "health watchdog: peer rank %d failed: %s",
-                        rank, reason)
-                    self.report_peer_failure(rank, reason)
-                    try:
-                        self.on_failure(rank, reason)
-                    except Exception:
-                        hvd_logging.exception(
-                            "health on_failure callback failed")
-                return  # one failure decision per watchdog lifetime
+                        _metrics.HEALTH_PEER_FAILURES.inc(
+                            labels={"rank": rank})
+                        hvd_logging.error(
+                            "health watchdog: peer rank %d failed: %s",
+                            rank, reason)
+                        if local_rank not in self._left:
+                            # graceful leavers are never reported to
+                            # the elastic driver: no blacklist, no
+                            # misattributed re-form
+                            self.report_peer_failure(rank, reason)
+                        try:
+                            self.on_failure(rank, reason)
+                        except Exception:
+                            hvd_logging.exception(
+                                "health on_failure callback failed")
+                    # One failure DECISION per watchdog lifetime — but
+                    # keep BEATING until stop(): the old `return` also
+                    # silenced this rank's beats, so the first peer to
+                    # detect a death looked freshly dead to every peer
+                    # that hadn't decided yet — a cascade of
+                    # misattributed deaths (observed under scripted
+                    # churn: a survivor's report blacklisted a LIVE
+                    # host and derailed the whole schedule). Only real
+                    # teardown may cease beats.
+                    decided = True
             self._stop.wait(self.interval_s)
 
     def _publish_beat(self) -> None:
@@ -383,6 +439,7 @@ class HealthWatchdog:
     def _check_peers(self):
         """Return ``(local rank, reason)`` for the first dead peer."""
         now = _inv.monotonic()
+        self._check_left()
         dead = self._check_poison()
         if dead is not None:
             return dead
@@ -400,6 +457,17 @@ class HealthWatchdog:
                     continue  # never beaten: startup grace (see __init__)
                 silent_s = now - changed_at
             if silent_s > self.timeout_s:
+                if r in self._left:
+                    # Announced graceful departure: NOT a death — the
+                    # decision still fails this service's in-flight
+                    # waiters fast (work owed by a departed rank can
+                    # never complete), but the loop suppresses the
+                    # driver-side peer-failure report, so a leaver is
+                    # never blacklisted and a slow survivor cannot
+                    # misattribute a re-form teardown as a crash.
+                    return r, (f"left the world (graceful departure; "
+                               f"beats ceased {silent_s:.1f}s ago) — "
+                               "its pending work cannot complete")
                 return r, (f"no liveness beat for {silent_s:.1f}s "
                            f"(HVD_HEALTH_TIMEOUT={self.timeout_s:g}s)")
         return None

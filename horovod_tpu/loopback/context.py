@@ -50,8 +50,8 @@ class RankContext:
         "services", "service_unavailable",
         # ops/fusion_cycle.py per-rank scheduler
         "scheduler",
-        # ops/dispatch_cache.py per-rank plan store
-        "plans", "plan_epoch",
+        # ops/dispatch_cache.py per-rank plan store + elastic warm pool
+        "plans", "plan_epoch", "warm_plans",
         # ops/collectives.py per-rank auto-name counters
         "auto_counters",
         # loopback/dispatch.py per-rank exchange occurrence counters
@@ -79,6 +79,7 @@ class RankContext:
         self.scheduler = None
         self.plans = None  # OrderedDict, created lazily by dispatch_cache
         self.plan_epoch = None
+        self.warm_plans = None  # elastic warm re-form pool (same module)
         self.auto_counters: dict = {}
         self.xseq: dict = {}
         self.notification_manager = None

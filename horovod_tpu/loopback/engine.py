@@ -109,7 +109,16 @@ def _abrupt_stop(ctx: _ctx.RankContext, reason: str,
             sched.stop()
         except Exception:
             hvd_logging.exception("loopback: scheduler teardown failed")
-    for svc in list(ctx.services.values()):
+    # Snapshot + clear under the service lock: the rank's own main
+    # thread may be inside engine_service.reset_service()'s locked
+    # iteration over this same table (a preempted rank's clean exit
+    # racing the driver's terminate), and an unlocked clear() here blows
+    # that iteration up with "dictionary changed size during iteration".
+    from .. import engine_service as _es
+    with _es._service_lock:
+        svcs = list(ctx.services.values())
+        ctx.services.clear()
+    for svc in svcs:
         try:
             wd = svc.health_watchdog()
             if wd is not None:
@@ -119,7 +128,6 @@ def _abrupt_stop(ctx: _ctx.RankContext, reason: str,
             svc._fail_all(reason, exc)
         except Exception:
             hvd_logging.exception("loopback: service teardown failed")
-    ctx.services.clear()
     nm, ctx.notification_manager = ctx.notification_manager, None
     if nm is not None:
         try:
@@ -369,16 +377,28 @@ class world:
 def elastic_run(fn, *, np: int, min_np: int | None = None,
                 max_np: int | None = None, discovery=None,
                 extra_env=None, timeout: float | None = None,
-                reset_limit: int | None = None):
+                reset_limit: int | None = None,
+                churn_events: list | None = None):
     """Run an elastic loopback job: the REAL ``ElasticDriver`` + registry
     + rendezvous + discovery, with workers as loopback rank threads.
     ``fn`` is the worker body (the full "script": it calls ``hvd.init()``
     and typically ``hvd.elastic.run``). Returns ``(results, succeeded)``
-    mirroring ``elastic/launch.run_elastic``'s decision inputs."""
+    mirroring ``elastic/launch.run_elastic``'s decision inputs.
+    ``churn_events`` (optional list) receives the ScriptedChurn event log
+    — (monotonic seconds, action, host) per fired membership rule — when
+    ``HVD_FAULT_SPEC`` schedules churn (the elastic bench reads it)."""
     from ..elastic.bootstrap import make_elastic_infra
     from ..runner.launch import _free_port
+    from ..utils import faults as _faults
 
     base_env = dict(extra_env or {})
+    # Scripted churn (docs/elastic.md): `worker:add/remove/preempt` rules
+    # in HVD_FAULT_SPEC drive the discovery set through a ScriptedChurn
+    # handler, so spot/preemptible membership change is a seeded,
+    # replayable schedule. Requires a mutable discovery (FixedHosts).
+    from ..elastic.discovery import install_scripted_churn
+    churn = install_scripted_churn(discovery, events=churn_events,
+                                   warn=True)
     if timeout is None and envs.get(envs.ELASTIC_TIMEOUT) is None:
         # elastic round/start deadlines scale with world size like the
         # static run deadline (ISSUE 13 loopback-scale audit); an
@@ -411,6 +431,8 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
             fn, env, auto_init=False,
             name=f"{w.name}-{slot_info.hostname}[{slot_info.local_rank}]")
 
+    if churn is not None:
+        churn.attach_driver(driver)
     try:
         _check_devices(max_np or np)
         driver.start(np, create_worker_fn)
@@ -418,6 +440,8 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
         results = driver.get_results()
         succeeded = driver.succeeded
     finally:
+        if churn is not None:
+            _faults.clear_membership_handler()
         infra.stop()
         w.shutdown()
     return results, succeeded

@@ -517,6 +517,32 @@ FAULT_FIRES = counter(
     "Injected faults fired per site (HVD_FAULT_SPEC chaos runs only).",
     labels=("site",))
 
+# -- elastic churn / warm re-form (elastic/, docs/elastic.md) --------------
+ELASTIC_EVENTS = counter(
+    "hvd_elastic_events_total",
+    "Elastic membership + recovery events by kind: scripted churn "
+    "(add / remove / preempt), worker-side recoveries (hosts-updated "
+    "interrupt, peer-failure restore).",
+    labels=("kind",))
+ELASTIC_REFORM_SECONDS = histogram(
+    "hvd_elastic_reform_seconds",
+    "Worker-side re-form duration: interrupt/failure caught -> "
+    "re-rendezvoused into the new round, state synced, training "
+    "re-entered (the recovery-time SLO numerator).",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 45.0, 90.0, 180.0))
+ELASTIC_STEPS_LOST = counter(
+    "hvd_elastic_steps_lost_total",
+    "In-flight steps rolled back by a failure restore (commit-per-step "
+    "convention: each HorovodInternalError recovery counts its one "
+    "uncommitted step; graceful interrupts count zero).")
+ELASTIC_WARM_REUSE = counter(
+    "hvd_elastic_warm_reuse_total",
+    "Shape-keyed state reused across an elastic re-form, by kind: "
+    "plan (dispatch plans grafted from the warm pool), step (whole-step "
+    "capture plans), response (coordinator response-cache entries "
+    "re-armed after the warm confirmation round).",
+    labels=("kind",), always=True)
+
 
 # --------------------------------------------------------------------------
 # snapshot / delta

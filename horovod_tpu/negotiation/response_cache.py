@@ -79,11 +79,51 @@ def signature(req: dict) -> tuple:
 
 
 class _Entry:
-    __slots__ = ("response", "confirmed")
+    __slots__ = ("response", "confirmed", "warm")
 
-    def __init__(self, response: Response, confirmed: bool):
+    def __init__(self, response: Response, confirmed: bool,
+                 warm: bool = False):
         self.response = response
         self.confirmed = confirmed
+        # Restored across an elastic re-form to the same process-set
+        # shape (docs/elastic.md): unconfirmed until the one-time warm
+        # digest round proves every member restored identical content.
+        self.warm = warm
+
+
+# --------------------------------------------------------------------------
+# Elastic warm re-form shelf (docs/elastic.md): a gracefully stopping
+# service shelves its entries keyed by (world scope, process set, world
+# size, rank); the same-shape successor restores them WARM — unconfirmed
+# until the coordinator's one-time warm-digest exchange (engine_service)
+# proves every member restored byte-identical content, at which point
+# one real round re-arms local serving (vs. two cold: populate+confirm).
+# --------------------------------------------------------------------------
+
+_SHELF_SHAPES = 16
+_shelf_mu = threading.Lock()
+_shelf: "OrderedDict[tuple, list]" = OrderedDict()
+
+
+def shelve(shape: tuple, items: list) -> None:
+    """Park a stopping service's entries under its shape key."""
+    if not items:
+        return
+    with _shelf_mu:
+        _shelf[shape] = items
+        _shelf.move_to_end(shape)
+        while len(_shelf) > _SHELF_SHAPES:
+            _shelf.popitem(last=False)
+
+
+def take_shelved(shape: tuple) -> list | None:
+    with _shelf_mu:
+        return _shelf.pop(shape, None)
+
+
+def clear_shelf() -> None:
+    with _shelf_mu:
+        _shelf.clear()
 
 
 class ResponseCache:
@@ -163,6 +203,76 @@ class ResponseCache:
             self._misses += n
         self._m_misses.inc(n)
 
+    # -- elastic warm re-form ----------------------------------------------
+
+    def export_entries(self) -> list:
+        """Shelvable snapshot: (name, signature, response) for every
+        confirmed-or-warm entry, in insertion order (the digest is
+        order-insensitive; sorted on computation)."""
+        with self._mu:
+            return [(name, held[0], held[1].response)
+                    for name, held in self._entries.items()
+                    if held[1].confirmed or held[1].warm]
+
+    def restore_warm(self, items: list) -> int:
+        """Adopt a shelved snapshot as WARM entries: present but
+        unserveable until :meth:`confirm_warm` (the digest round proved
+        world-wide agreement) — a lone rank restoring entries its peers
+        lost must never serve locally while they negotiate."""
+        n = 0
+        with self._mu:
+            for name, sig, resp in items:
+                if len(self._entries) >= self.capacity:
+                    break
+                self._entries[name] = (sig, _Entry(resp, False, warm=True))
+                n += 1
+        return n
+
+    def warm_count(self) -> int:
+        with self._mu:
+            return sum(1 for _, e in self._entries.values() if e.warm)
+
+    def warm_digest(self) -> bytes:
+        """Content digest of the warm set (8 bytes): equal digests on
+        every member mean every member restored identical entries. An
+        empty warm set digests to the distinct empty marker so a fresh
+        replacement rank (no shelf) forces the cold path everywhere."""
+        import zlib
+        with self._mu:
+            items = sorted(
+                (name, held[0], repr(held[1].response))
+                for name, held in self._entries.items() if held[1].warm)
+        if not items:
+            return b"\x00" * 8
+        crc = 0
+        for name, sig, resp_repr in items:
+            crc = zlib.crc32(repr((name, sig, resp_repr)).encode(), crc)
+        return len(items).to_bytes(4, "little") + crc.to_bytes(4, "little")
+
+    def confirm_warm(self) -> int:
+        """Every member proved it restored the identical warm set: flip
+        warm entries to confirmed. Serving still additionally requires
+        the NATIVE cache to hold each name (one real round per name),
+        so a warm re-form re-arms after one confirmation round."""
+        n = 0
+        with self._mu:
+            for _, e in self._entries.values():
+                if e.warm:
+                    e.warm = False
+                    e.confirmed = True
+                    n += 1
+        return n
+
+    def drop_warm(self) -> int:
+        """Digest disagreement (a fresh member, divergent shelves) or
+        the digest round failed: fall back to the cold two-round path."""
+        with self._mu:
+            stale = [name for name, held in self._entries.items()
+                     if held[1].warm]
+            for name in stale:
+                del self._entries[name]
+        return len(stale)
+
     # -- invalidation ------------------------------------------------------
 
     def invalidate(self, reason: str = "") -> int:
@@ -197,6 +307,7 @@ class ResponseCache:
                 "entries": len(self._entries),
                 "confirmed": sum(1 for _, e in self._entries.values()
                                  if e.confirmed),
+                "warm": sum(1 for _, e in self._entries.values() if e.warm),
                 "hits": self._hits,
                 "misses": self._misses,
                 "served_batches": self._served_batches,

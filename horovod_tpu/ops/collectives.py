@@ -876,8 +876,7 @@ def _plan_negotiation(kind: str, request_type: int, name: str | None,
     cache via the bitvector AND (the reference ``ComputeResponseList`` HIT
     path) instead of a full metadata exchange."""
     from .. import engine_service
-    svc = engine_service.get_service(pset)
-    if svc is None:
+    if engine_service.get_service(pset) is None:
         return None
     neg_name = name or _auto_name(kind, pset)
     dt = jnp.dtype(dtype)
@@ -885,6 +884,16 @@ def _plan_negotiation(kind: str, request_type: int, name: str | None,
                   shape=tuple(int(d) for d in shape), **meta)
 
     def negotiate():
+        # Re-resolve the service per call instead of pinning the build-time
+        # object: an elastic re-form rebuilds services, and lazy resolution
+        # is what lets a warm-grafted plan (docs/elastic.md) negotiate
+        # against the NEW world. Table-hit resolution costs ~1us against a
+        # millisecond-scale KV round.
+        svc = engine_service.get_service(pset)
+        if svc is None:
+            raise RuntimeError(
+                f"negotiation service gone for plan {neg_name!r} (world "
+                "reset mid-call?); re-issue the collective")
         resp = svc.negotiate(neg_name, request_type, **kwargs)
         if resp is not None and resp.from_cache:
             _dispatch.note_negotiation_skip()
@@ -899,13 +908,19 @@ def _plan_group_negotiation(kind: str, request_type: int, name: str | None,
     """Grouped twin of :func:`_plan_negotiation`: the request batch is
     assembled once and replayed with stable names on every hit."""
     from .. import engine_service
-    svc = engine_service.get_service(pset)
-    if svc is None:
+    if engine_service.get_service(pset) is None:
         return None
     reqs = _group_requests(name or _auto_name(kind, pset), request_type,
                            shapes_dtypes, **meta)
 
     def negotiate():
+        # lazy per-call resolution — see _plan_negotiation
+        svc = engine_service.get_service(pset)
+        if svc is None:
+            raise RuntimeError(
+                "negotiation service gone for grouped plan "
+                f"{reqs[0]['name'] if reqs else '?'!r} (world reset "
+                "mid-call?); re-issue the collective")
         resps = svc.negotiate_many(reqs)
         if resps and all(r.from_cache for r in resps):
             _dispatch.note_negotiation_skip()

@@ -95,6 +95,146 @@ _lock = _inv.make_lock("dispatch_cache.lock")
 _plans: "OrderedDict[tuple, DispatchPlan]" = OrderedDict()
 _epoch: tuple | None = None
 
+# --------------------------------------------------------------------------
+# Elastic warm re-form (docs/elastic.md): instead of dropping every plan
+# when a world resizes, the re-form teardown SHELVES the store keyed by
+# process-set shape (world scope, size, own rank), and a later re-form
+# back to that shape adopts it as a WARM POOL. Warm plans are never
+# served from the pool directly — negotiation names must be re-derived
+# through the normal build path so auto-name counters stay in lockstep
+# on every member (a fresh replacement rank has no pool and builds cold)
+# — instead `store()` grafts a pool plan's compiled `execute` stage onto
+# the newly built plan when the keys AND derived negotiation names
+# match, skipping the first-call retrace/recompile. A genuinely new
+# shape simply never matches its shelf entry; registered-process-set
+# keys are excluded (their numeric ids are not stable across worlds), so
+# a resize invalidates exactly those affected sets.
+# --------------------------------------------------------------------------
+
+# Shapes retained process-wide (LRU). One loopback elastic run touches
+# up to world_max shapes per size it visits (one per (size, rank)); 32
+# covers a 4..8-world churn history without evicting a shape mid-cycle.
+_SHELF_SHAPES = 32
+_shelf: "OrderedDict[tuple, dict]" = OrderedDict()
+_warm_plans: dict = {}  # non-loopback warm pool (loopback: ctx.warm_plans)
+
+
+def _current_shape() -> tuple | None:
+    """Shape key of this thread's world: (world scope, size, rank).
+    Loopback scopes by the LoopbackWorld name so one world's re-forms
+    reuse each other's shelves but distinct worlds never cross."""
+    from .. import runtime
+    if not runtime.is_initialized():
+        return None
+    from ..loopback import context as _lbctx
+    ctx = _lbctx.current()
+    scope = ctx.world.name if ctx is not None else "proc"
+    return (scope, runtime.process_count(), runtime.process_rank())
+
+
+def _restorable(key: tuple, plan) -> bool:
+    if plan is UNPLANNABLE:
+        return False
+    if getattr(plan, "variant", None) == "step":
+        return bool(getattr(plan, "rebindable", False))
+    # Eager plan keys carry the pset dispatch_key at index 4: "g" (an
+    # unregistered global view), id 0 (THE global set — every world
+    # registers it as 0), and rank tuples are self-describing across
+    # worlds; other registered ids are not (a re-formed world may hand
+    # the same number to a different rank list) — those stay flushed,
+    # which is the "invalidate exactly the affected process sets" rule.
+    if len(key) > 4 and isinstance(key[4], int) \
+            and not isinstance(key[4], bool):
+        return key[4] == 0
+    return True
+
+
+def shelve_for_reform() -> int:
+    """Move this world's restorable plans onto the shape-keyed shelf
+    (called by the re-form teardown BEFORE the store is invalidated).
+    Unconsumed warm-pool leftovers ride along — they are plans of this
+    same shape a short incarnation never got to rebuild."""
+    if not envs.elastic_warm_enabled() or capacity() <= 0:
+        return 0
+    shape = _current_shape()
+    if shape is None:
+        return 0
+    global _warm_plans
+    epoch = envs.override_epoch()
+    ctx = _ctx_store()
+    plans = ctx.plans if ctx is not None else _plans
+    with _lock:
+        keep = {k: p for k, p in plans.items() if _restorable(k, p)}
+        for k in keep:
+            plans.pop(k, None)
+        pool = ctx.warm_plans if ctx is not None else _warm_plans
+        for k, p in (pool or {}).items():
+            keep.setdefault(k, p)
+        if ctx is not None:
+            ctx.warm_plans = None
+        else:
+            _warm_plans = {}
+        if not keep:
+            return 0
+        merged = _shelf.get(shape)
+        if merged is not None and merged["epoch"] == epoch:
+            merged["plans"].update(keep)
+        else:
+            _shelf[shape] = {"plans": keep, "epoch": epoch}
+        _shelf.move_to_end(shape)
+        while len(_shelf) > _SHELF_SHAPES:
+            _shelf.popitem(last=False)
+        return len(keep)
+
+
+def restore_for_reform() -> int:
+    """Adopt the shelf entry matching this (re-formed) world's shape as
+    the warm pool (called at the end of init). Returns the pool size;
+    0 when the shape was never seen, warm re-form is off, or a knob
+    override changed the wire composition the shelved programs baked."""
+    if not envs.elastic_warm_enabled() or capacity() <= 0:
+        return 0
+    shape = _current_shape()
+    if shape is None:
+        return 0
+    global _warm_plans
+    ctx = _ctx_store()
+    with _lock:
+        entry = _shelf.pop(shape, None)
+        if entry is None:
+            return 0
+        if entry["epoch"] != envs.override_epoch():
+            _metrics.DISPATCH_INVALIDATIONS.inc(len(entry["plans"]))
+            return 0
+        if ctx is not None:
+            ctx.warm_plans = entry["plans"]
+        else:
+            _warm_plans = entry["plans"]
+        return len(entry["plans"])
+
+
+def _warm_graft_locked(ctx, key: tuple, plan) -> None:
+    """Graft a warm-pool plan's compiled ``execute`` onto the newly
+    built ``plan`` for the same key — valid only when the re-derived
+    negotiation name matches the shelved one (then the loopback
+    rendezvous keys and wire composition are identical by construction).
+    Caller holds ``_lock``."""
+    pool = ctx.warm_plans if ctx is not None else _warm_plans
+    if not pool or plan is UNPLANNABLE:
+        return
+    warm = pool.pop(key, None)
+    if warm is None or warm is UNPLANNABLE:
+        return
+    if type(warm) is not type(plan) or warm.variant != plan.variant \
+            or warm.pieces != plan.pieces:
+        return
+    if getattr(warm.negotiate, "neg_name", None) != \
+            getattr(plan.negotiate, "neg_name", None):
+        return
+    plan.execute = warm.execute
+    _metrics.ELASTIC_WARM_REUSE.inc(labels={
+        "kind": "step" if plan.variant == "step" else "plan"})
+
 
 def _ctx_store():
     """Loopback rank threads get their own plan map: plan keys repeat
@@ -252,6 +392,9 @@ def store(key: tuple, plan: DispatchPlan) -> None:
         if plan is not UNPLANNABLE and plan.variant == "step":
             _metrics.DISPATCH_STEP_BUILDS.inc()
         _sync_epoch_locked(ctx, plans, epoch)
+        # Elastic warm re-form: adopt the shelved incarnation's compiled
+        # execute stage before the first call pays the retrace/recompile.
+        _warm_graft_locked(ctx, key, plan)
         plans[key] = plan
         plans.move_to_end(key)
         while len(plans) > cap:
@@ -265,12 +408,19 @@ def invalidate(reason: str | None = None) -> int:
     """Flush every cached plan (process-set removal, service reset,
     shutdown) in this thread's world — a loopback rank invalidates its
     own store. Returns the number of plans dropped."""
+    global _warm_plans
     del reason
     ctx = _ctx_store()
     plans = ctx.plans if ctx is not None else _plans
     with _lock:
         n = len(plans)
         _flush_store_locked(plans, count_invalidation=True)
+        # the warm pool holds plans of THIS world's shape; whatever
+        # invalidated the store (pset removal, service reset) applies
+        if ctx is not None:
+            ctx.warm_plans = None
+        else:
+            _warm_plans = {}
     return n
 
 
@@ -289,10 +439,16 @@ def stats() -> dict:
     by_source = {s: 0 for s in _SOURCES}
     for labelitems, v in _metrics.DISPATCH_HITS.series().items():
         by_source[dict(labelitems).get("source", "call")] = int(v)
+    warm_reuses = 0
+    for labelitems, v in _metrics.ELASTIC_WARM_REUSE.series().items():
+        if dict(labelitems).get("kind") in ("plan", "step"):
+            warm_reuses += int(v)
     ctx = _ctx_store()
     plans = ctx.plans if ctx is not None else _plans
     with _lock:
         size = len(plans)
+        pool = ctx.warm_plans if ctx is not None else _warm_plans
+        warm_pool = len(pool or {})
     return {
         "enabled": enabled(),
         "capacity": capacity(),
@@ -306,6 +462,10 @@ def stats() -> dict:
             _metrics.DISPATCH_NEGOTIATION_SKIPS.value()),
         "chunked_builds": int(_metrics.DISPATCH_CHUNKED_BUILDS.value()),
         "step_builds": int(_metrics.DISPATCH_STEP_BUILDS.value()),
+        # elastic warm re-form (docs/elastic.md): plans waiting in this
+        # world's warm pool, and compiled stages grafted from it
+        "warm_pool": warm_pool,
+        "warm_reuses": warm_reuses,
     }
 
 
@@ -320,9 +480,11 @@ def reset_stats() -> None:
 
 
 def reset() -> None:
-    """Tests / teardown: drop plans AND counters."""
-    global _epoch
+    """Tests / teardown: drop plans, shelves, pools AND counters."""
+    global _epoch, _warm_plans
     with _lock:
         _plans.clear()
         _epoch = None
+        _shelf.clear()
+        _warm_plans = {}
     reset_stats()

@@ -145,14 +145,24 @@ class StepPlan(_dispatch.DispatchPlan):
     invalidation path (knob-override epoch, process-set removal, service
     reset, shutdown, LRU pressure) drops it like any other plan."""
 
-    __slots__ = ("key", "records", "entries_total")
+    __slots__ = ("key", "records", "entries_total", "rebindable")
 
-    def __init__(self, key, records, run_step, nbytes, pieces):
+    def __init__(self, key, records, run_step, nbytes, pieces,
+                 rebindable: bool = False):
         super().__init__("step", "STEP_REPLAY", nbytes, None, run_step,
                          variant="step", pieces=pieces)
         self.key = key
         self.records = records
         self.entries_total = sum(len(r.templates) for r in records)
+        # Whether the executor survives an elastic re-form to the same
+        # process-set shape (docs/elastic.md): negotiated streams over
+        # the GLOBAL set resolve their service and mesh lazily per
+        # replay, so their whole-step executor can be warm-grafted
+        # across worlds. Single-controller streams bake mesh-bound jits,
+        # and registered non-global sets bake old-world membership (the
+        # numeric id may alias a different rank list after the resize) —
+        # both stay world-local.
+        self.rebindable = rebindable
 
 
 # ---------------------------------------------------------------------------
@@ -344,13 +354,20 @@ def _make_svc_execute(records):
     entry's submission-time program composition — identical to what a
     joined rank reconstructs from response metadata, so active and
     joined processes keep lowering the same programs."""
-    svc = records[0].spec.svc
+    pset = records[0].spec.pset
+    build_svc = records[0].spec.svc
 
     def execute(entries_per_record):
+        from .. import engine_service
         from . import collectives as _coll
         reqs = [r for entries in entries_per_record
                 for e in entries for r in e.requests]
         if reqs:
+            # Resolve the service per replay, not at build: an elastic
+            # re-form back to this shape rebuilds services, and lazy
+            # resolution is what lets a warm-restored step plan
+            # (docs/elastic.md) negotiate against the NEW world.
+            svc = engine_service.get_service(pset) or build_svc
             svc.negotiate_step(reqs)
         out = []
         for rec, entries in zip(records, entries_per_record):
@@ -571,7 +588,10 @@ class CaptureState:
             run_step, nbytes = _make_jit_execute(records)
         else:
             run_step, nbytes = _make_svc_execute(records)
-        return StepPlan(key, records, run_step, nbytes, len(records))
+        return StepPlan(key, records, run_step, nbytes, len(records),
+                        rebindable=svc is not None and all(
+                            getattr(r.spec.pset, "is_global", False)
+                            for r in records))
 
     def _arm_locked(self, plan: StepPlan) -> None:
         self._plan = plan

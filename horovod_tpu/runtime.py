@@ -222,6 +222,16 @@ def _loopback_init(ctx, *, axis_name: str = AXIS_NAME,
     _timeline.maybe_autostart()
     from . import engine_service as _engine_service
     _engine_service.get_service()
+    # Elastic warm re-form: adopt the shelf entry for this exact shape
+    # (world scope, size, rank) as the warm pool — plan builds from here
+    # on graft the shelved incarnation's compiled stages when their
+    # re-derived negotiation names match (ops/dispatch_cache.py).
+    from .ops import dispatch_cache as _dispatch_cache
+    warm = _dispatch_cache.restore_for_reform()
+    if warm:
+        hvd_logging.info(
+            "loopback init: %d shelved dispatch plans warm for rank %d "
+            "of %d", warm, rank, size)
 
 
 def _distributed_client_active() -> bool:
@@ -498,6 +508,14 @@ def _loopback_shutdown(ctx) -> None:
     except Exception:
         hvd_logging.exception(
             "loopback fusion-cycle drain failed at shutdown")
+    # Elastic warm re-form (docs/elastic.md): park this rank's restorable
+    # plans on the shape-keyed shelf BEFORE the service reset invalidates
+    # the store — a later re-form back to this shape grafts their
+    # compiled stages instead of re-tracing. No-op under HVD_ELASTIC_WARM=0.
+    shelved = _dispatch_cache.shelve_for_reform()
+    if shelved:
+        hvd_logging.debug("loopback shutdown: shelved %d dispatch plans",
+                          shelved)
     _engine_service.reset_service()
     _dispatch_cache.invalidate("loopback runtime shutdown")
     sched, ctx.scheduler = ctx.scheduler, None

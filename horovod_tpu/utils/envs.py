@@ -50,6 +50,8 @@ PIPELINE_PINGPONG = "PIPELINE_PINGPONG"  # auto|1|0: recycle wire buffers across
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 DYNAMIC_ENGINE = "DYNAMIC_ENGINE"  # 0 disables multi-process negotiation
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
+ELASTIC_GRACE = "ELASTIC_GRACE"  # s a slot-removed worker gets to exit cleanly (0 = immediate kill)
+ELASTIC_WARM = "ELASTIC_WARM"  # auto|1|0: shape-keyed cache survival across elastic re-forms
 GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
 SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # force sparse grads onto dense allreduce
 BUCKET_BYTES = "BUCKET_BYTES"  # gradient bucket size for backward-pass overlap (0 = whole-tree)
@@ -443,6 +445,22 @@ def hier_negotiation_enabled(world_size: int) -> bool:
     if val in ("0", "false", "no", "off"):
         return False
     return world_size > negotiation_group_size()
+
+
+# Elastic warm re-form (docs/elastic.md): plan stores / step plans /
+# coordinator response-cache entries are keyed by process-set *shape*
+# and survive a world resize instead of being flushed wholesale — a
+# resize back to a previously-seen shape (the common preemption-then-
+# recovery case) reuses them. 'auto' enables this only on loopback rank
+# threads: a process-path re-form tears down the XLA backend
+# (clear_backends), so compiled programs cannot outlive the world there.
+def elastic_warm_enabled() -> bool:
+    val = (get(ELASTIC_WARM, "auto") or "auto").strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    return _lbctx.current() is not None
 
 
 def donation_effective(platform: str) -> bool:

@@ -19,12 +19,26 @@ Spec grammar (semicolon-separated rules)::
 * **site** — injection-point name (table in docs/robustness.md); a
   trailing ``*`` prefix-matches (``kv.*`` covers put/get/delete).
 * **action** — ``error`` (raise :class:`FaultInjected`), ``crash``
-  (``os._exit``; code via ``code=N``, default 1), or ``delay=<seconds>``
-  (sleep, then continue).
+  (``os._exit``; code via ``code=N``, default 1), ``delay=<seconds>``
+  (sleep, then continue), or — at the ``worker`` site only — a
+  **membership action** driving elastic churn (docs/elastic.md):
+  ``add`` (``count=K`` fresh hosts join discovery), ``remove`` (the
+  firing rank's host leaves discovery; the driver reclaims it
+  abruptly), ``preempt`` (SIGTERM-style: the departing rank drains its
+  in-flight flushes at the commit boundary, its host leaves discovery,
+  and the driver grants it ``grace=S`` seconds to exit cleanly through
+  the slot-lost path instead of terminating it mid-collective).
+  Membership actions fire through the handler installed by the elastic
+  front end (:func:`set_membership_handler`); with no handler they log
+  and no-op. They default to ``times=1`` — one scheduled event each.
 * **filters** — ``p=<0..1>`` fire probability (deterministic, from
   ``seed=``), ``after=N`` skip the first N matching calls, ``times=N``
-  fire at most N times, ``rank=R`` / ``at_step=S`` match the caller's
-  context (``rank`` falls back to the launcher-seeded ``HVD_RANK``).
+  fire at most N times, ``rank=R`` / ``at_step=S`` / ``at_round=R``
+  match the caller's context (``rank`` falls back to the
+  launcher-seeded ``HVD_RANK``; ``at_step`` counts ``State.commit``
+  calls; ``at_round`` matches the elastic round the worker currently
+  runs in — ``HVD_ELASTIC_ROUND`` — so schedules can target re-form
+  boundaries deterministically).
 
 Determinism: the probability draw is **not** ``random`` — it hashes
 ``(seed, site, call-index)`` through ``zlib.crc32``, so a fixed seed
@@ -62,12 +76,17 @@ class FaultSpecError(ValueError):
 
 
 _ACTIONS = ("error", "crash", "delay")
+# Elastic-churn membership actions (docs/elastic.md): legal only at the
+# `worker` site (State.commit — the step boundary), dispatched through
+# the handler the elastic front end installs. Scheduled events, so they
+# default to firing exactly once.
+_MEMBERSHIP_ACTIONS = ("add", "remove", "preempt")
 
 
 class _Rule:
     __slots__ = ("site", "action", "delay_s", "exit_code", "p", "seed",
-                 "after", "times", "rank", "at_step", "text",
-                 "calls", "fires")
+                 "after", "times", "rank", "at_step", "at_round", "text",
+                 "count", "grace_s", "calls", "fires")
 
     def __init__(self, text: str):
         self.text = text
@@ -90,17 +109,28 @@ class _Rule:
                     f"{action[len('delay='):]!r}")
         elif action in ("error", "crash"):
             self.action = action
+        elif action in _MEMBERSHIP_ACTIONS:
+            if self.site != "worker":
+                raise FaultSpecError(
+                    f"fault rule {text!r}: membership action {action!r} is "
+                    "only legal at the 'worker' site (the commit boundary)")
+            self.action = action
         else:
             raise FaultSpecError(
                 f"fault rule {text!r}: unknown action {action!r} "
-                f"(expected one of {_ACTIONS}, delay as 'delay=<seconds>')")
+                f"(expected one of {_ACTIONS + _MEMBERSHIP_ACTIONS}, "
+                "delay as 'delay=<seconds>')")
         self.exit_code = 1
         self.p = 1.0
         self.seed = 0
         self.after = 0
-        self.times: int | None = None
+        self.times: int | None = (
+            1 if self.action in _MEMBERSHIP_ACTIONS else None)
         self.rank: int | None = None
         self.at_step: int | None = None
+        self.at_round: int | None = None
+        self.count = 1          # add: hosts to add
+        self.grace_s = 30.0     # preempt: driver-side stale-worker grace
         for param in parts[2:]:
             key, sep, value = param.partition("=")
             key = key.strip()
@@ -121,8 +151,14 @@ class _Rule:
                     self.rank = int(value)
                 elif key == "at_step":
                     self.at_step = int(value)
+                elif key == "at_round":
+                    self.at_round = int(value)
                 elif key == "code":
                     self.exit_code = int(value)
+                elif key == "count":
+                    self.count = int(value)
+                elif key == "grace":
+                    self.grace_s = float(value)
                 else:
                     raise FaultSpecError(
                         f"fault rule {text!r}: unknown parameter {key!r}")
@@ -134,6 +170,12 @@ class _Rule:
         if not 0.0 <= self.p <= 1.0:
             raise FaultSpecError(
                 f"fault rule {text!r}: p={self.p} outside [0, 1]")
+        if self.count < 1:
+            raise FaultSpecError(
+                f"fault rule {text!r}: count={self.count} must be >= 1")
+        if self.grace_s < 0:
+            raise FaultSpecError(
+                f"fault rule {text!r}: grace={self.grace_s} must be >= 0")
         self.calls = 0  # matching calls seen (drives `after` and the draw)
         self.fires = 0
 
@@ -149,13 +191,17 @@ class _Rule:
         h = zlib.crc32(f"{self.seed}:{self.site}:{call_index}".encode())
         return (h & 0xFFFFFFFF) / float(1 << 32)
 
-    def should_fire(self, rank: int | None, step: int | None) -> bool:
+    def should_fire(self, rank: int | None, step: int | None,
+                    round_id: int | None = None) -> bool:
         """Advance this rule's call counter for a site match and decide.
         Caller holds the spec lock."""
         if self.rank is not None and (rank is None or rank != self.rank):
             return False
         if self.at_step is not None and (step is None
                                          or step != self.at_step):
+            return False
+        if self.at_round is not None and (round_id is None
+                                          or round_id != self.at_round):
             return False
         self.calls += 1
         if self.calls <= self.after:
@@ -169,7 +215,7 @@ class _Rule:
 
 
 class _Spec:
-    __slots__ = ("rules", "mu", "default_rank")
+    __slots__ = ("rules", "mu", "default_rank", "needs_round")
 
     def __init__(self, text: str):
         self.rules = [_Rule(part.strip())
@@ -182,6 +228,9 @@ class _Spec:
         self.default_rank = envs.get_int(envs.RANK, -1)
         if self.default_rank < 0:
             self.default_rank = None
+        # Elastic round context is only read from the env when a rule
+        # filters on it (the common non-elastic chaos run skips the read).
+        self.needs_round = any(r.at_round is not None for r in self.rules)
         self.mu = threading.Lock()
 
 
@@ -227,6 +276,37 @@ def stats() -> dict:
     with spec.mu:
         return {r.text: {"site": r.site, "calls": r.calls, "fires": r.fires}
                 for r in spec.rules}
+
+
+# --------------------------------------------------------------------------
+# elastic-churn membership actions (docs/elastic.md): `worker:add/remove/
+# preempt` rules fire through a handler the elastic front end installs
+# (loopback `elastic_run` wires `discovery.ScriptedChurn`). The handler
+# runs on the firing rank's thread at its commit boundary, so it can read
+# the rank's env contract (HVD_HOSTNAME) and drain the rank's own queues.
+# --------------------------------------------------------------------------
+
+_membership_handler = None
+
+
+def set_membership_handler(handler) -> None:
+    """Install ``handler(action: str, rule)`` for membership actions.
+    One handler per process (the elastic driver front end owns churn)."""
+    global _membership_handler
+    _membership_handler = handler
+
+
+def clear_membership_handler() -> None:
+    global _membership_handler
+    _membership_handler = None
+
+
+def has_membership_rules() -> bool:
+    """Whether the installed spec schedules any membership churn — the
+    elastic front ends use this to decide whether to wire a handler."""
+    spec = _SPEC
+    return spec is not None and any(
+        r.action in _MEMBERSHIP_ACTIONS for r in spec.rules)
 
 
 def _crash(code: int) -> None:  # monkeypatched by tests
@@ -286,12 +366,16 @@ def inject(site: str, *, rank: int | None = None,
         return
     if rank is None:
         rank = _caller_rank(spec)
+    round_id = None
+    if spec.needs_round:
+        r = envs.get_int(envs.ELASTIC_ROUND, -1)
+        round_id = r if r >= 0 else None
     fired = None
     with spec.mu:
         for rule in spec.rules:
             if not rule.matches_site(site):
                 continue
-            if rule.should_fire(rank, step):
+            if rule.should_fire(rank, step, round_id):
                 fired = rule
                 break
     if fired is None:
@@ -303,5 +387,16 @@ def inject(site: str, *, rank: int | None = None,
         return
     if fired.action == "crash":
         _crash(fired.exit_code)
+        return
+    if fired.action in _MEMBERSHIP_ACTIONS:
+        handler = _membership_handler
+        if handler is None:
+            from . import logging as hvd_logging
+            hvd_logging.warning(
+                "membership fault %r fired with no churn handler "
+                "installed (elastic front end not wired); ignoring",
+                fired.text)
+            return
+        handler(fired.action, fired)
         return
     raise FaultInjected(site, fired.text)

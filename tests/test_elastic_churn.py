@@ -1,0 +1,607 @@
+"""Elastic churn as a measured scenario (ISSUE 14; docs/elastic.md).
+
+Scripted membership change through the ``HVD_FAULT_SPEC`` grammar
+(``worker:add/remove/preempt``), warm re-form (shape-keyed dispatch-plan
+shelves + coordinator ResponseCache re-arm), recovery SLOs, and the
+typed ResponseCacheJoinError for the pre-join-latch serving race.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import _native
+from horovod_tpu.dynamic import REQ_ALLREDUCE, REQ_JOIN, NativeEngine
+from horovod_tpu.exceptions import ResponseCacheJoinError
+from horovod_tpu.utils import envs
+from horovod_tpu.utils import faults as _faults
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native engine unavailable")
+
+FAST_HEALTH = {"HVD_HEALTH_INTERVAL": "0.2", "HVD_HEALTH_TIMEOUT": "2",
+               "HVD_RESPONSE_CACHE": "1"}
+
+
+@pytest.fixture
+def fault_spec():
+    """Install an HVD_FAULT_SPEC for the test and always clear it."""
+    import os
+
+    def install(spec):
+        os.environ["HVD_FAULT_SPEC"] = spec
+        _faults.refresh()
+
+    yield install
+    import os
+    os.environ.pop("HVD_FAULT_SPEC", None)
+    _faults.refresh()
+    _faults.clear_membership_handler()
+
+
+# ---------------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------------
+
+class TestChurnGrammar:
+    def test_membership_actions_parse(self):
+        rules = _faults.parse_spec(
+            "worker:add:at_step=3:count=2;"
+            "worker:remove:rank=1:at_step=5;"
+            "worker:preempt:rank=2:at_step=7:grace=12.5")
+        add, rem, pre = rules
+        assert (add.action, add.count, add.times) == ("add", 2, 1)
+        assert (rem.action, rem.rank, rem.times) == ("remove", 1, 1)
+        assert (pre.action, pre.grace_s) == ("preempt", 12.5)
+
+    def test_membership_only_at_worker_site(self):
+        with pytest.raises(_faults.FaultSpecError,
+                           match="only legal at the 'worker' site"):
+            _faults.parse_spec("kv.put:add:count=1")
+
+    def test_bad_count_and_grace_rejected(self):
+        with pytest.raises(_faults.FaultSpecError, match="count"):
+            _faults.parse_spec("worker:add:count=0")
+        with pytest.raises(_faults.FaultSpecError, match="grace"):
+            _faults.parse_spec("worker:preempt:grace=-1")
+
+    def test_at_round_parses_on_any_action(self):
+        (r,) = _faults.parse_spec("worker:crash:rank=0:at_round=2")
+        assert r.at_round == 2
+
+    def test_at_round_filter_matches_elastic_round(self, fault_spec,
+                                                   monkeypatch):
+        """A rule keyed on at_round fires only in that elastic round —
+        the deterministic way to target re-form boundaries (ISSUE 14
+        satellite: at_step counts commits, which reset meaning across
+        worlds; at_round does not)."""
+        fired = []
+        fault_spec("worker:remove:at_round=3")
+        _faults.set_membership_handler(
+            lambda action, rule: fired.append(action))
+        monkeypatch.setenv("HVD_ELASTIC_ROUND", "2")
+        _faults.inject("worker", rank=0, step=1)
+        assert fired == []
+        monkeypatch.setenv("HVD_ELASTIC_ROUND", "3")
+        _faults.inject("worker", rank=0, step=2)
+        assert fired == ["remove"]
+        # membership actions default times=1: the schedule fires once
+        _faults.inject("worker", rank=0, step=3)
+        assert fired == ["remove"]
+
+    def test_membership_without_handler_noops(self, fault_spec):
+        fault_spec("worker:add:count=1")
+        _faults.clear_membership_handler()
+        _faults.inject("worker", rank=0, step=1)  # must not raise
+
+    def test_has_membership_rules(self, fault_spec):
+        fault_spec("kv.put:error:p=0.5")
+        assert not _faults.has_membership_rules()
+        fault_spec("kv.put:error:p=0.5;worker:preempt:rank=0:at_step=2")
+        assert _faults.has_membership_rules()
+
+
+# ---------------------------------------------------------------------------
+# scripted churn end to end (loopback elastic)
+# ---------------------------------------------------------------------------
+
+def _train_body(box, total_steps, probe_name="w", sleep_s=0.03,
+                collect_stats=False):
+    def body():
+        hvd.init()
+        state = hvd.elastic.JaxState(step=0, log=[])
+
+        @hvd.elastic.run
+        def train(state):
+            from horovod_tpu import metrics as _metrics
+            from horovod_tpu.ops import dispatch_cache
+            while state.step < total_steps:
+                out = hvd.allreduce(jnp.arange(4.0) + 1.0, op=hvd.Sum,
+                                    name=probe_name)
+                world = int(float(np.asarray(out).reshape(-1)[0]))
+                if hvd.rank() == 0:
+                    row = (state.step, world,
+                           float(np.asarray(out).reshape(-1)[1]))
+                    if collect_stats:
+                        st = dispatch_cache.stats()
+                        row = row + (st["warm_reuses"], int(
+                            _metrics.ELASTIC_STEPS_LOST.value()))
+                    state.log = state.log + [row]
+                state.step += 1
+                time.sleep(sleep_s)
+                state.commit()
+            return state.log
+
+        log = train(state)
+        if hvd.rank() == 0:
+            box["log"] = log
+        return 0
+
+    return body
+
+
+class TestScriptedChurn:
+    def test_grow_2_to_4_numerics_parity(self, fault_spec):
+        """Mid-training scale-up 2->4: after the re-form every logged
+        allreduce equals exactly what an uninterrupted world-4 run
+        computes, and committed steps never replay."""
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.loopback import elastic_run
+
+        fault_spec("worker:add:rank=0:at_step=2:count=2")
+        disco = FixedHosts({"g2a": 1, "g2b": 1})
+        box = {}
+        results, ok = elastic_run(
+            _train_body(box, 60), np=2, min_np=2, max_np=4,
+            discovery=disco, timeout=90, extra_env=FAST_HEALTH)
+        assert ok, results.error_message
+        log = box["log"]
+        worlds = [w for (_s, w, _p) in log]
+        assert worlds[0] == 2 and worlds[-1] == 4, worlds
+        assert sorted(set(worlds)) == [2, 4], worlds
+        # numerics parity vs an uninterrupted run at the final world:
+        # element 1 of sum(arange(4)+1) over `world` identical
+        # contributions is exactly 2*world at every step
+        for step, world, p1 in log:
+            assert p1 == pytest.approx(2.0 * world), (step, world, p1)
+        steps = [s for (s, _w, _p) in log]
+        assert steps == sorted(set(steps)), "committed steps replayed"
+
+    def test_shrink_4_to_2_numerics_parity(self, fault_spec):
+        """Mid-training scale-down 4->2 via two scheduled removals."""
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.loopback import elastic_run
+
+        fault_spec("worker:remove:rank=3:at_step=2;"
+                   "worker:remove:rank=2:at_step=14")
+        disco = FixedHosts({f"s4{i}": 1 for i in range(4)})
+        box = {}
+        results, ok = elastic_run(
+            _train_body(box, 40), np=4, min_np=2, max_np=4,
+            discovery=disco, timeout=120, extra_env=FAST_HEALTH)
+        assert ok, results.error_message
+        log = box["log"]
+        worlds = [w for (_s, w, _p) in log]
+        assert worlds[0] == 4 and worlds[-1] == 2, worlds
+        assert set(worlds) >= {4, 2}, worlds
+        for step, world, p1 in log:
+            assert p1 == pytest.approx(2.0 * world), (step, world, p1)
+
+    def test_warm_reform_reuses_plans(self, fault_spec):
+        """A resize back to a previously-seen shape must graft shelved
+        dispatch plans: `dispatch_cache_stats()["warm_reuses"]` > 0
+        after the second re-form (ISSUE 14 acceptance)."""
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.loopback import elastic_run
+
+        fault_spec("worker:preempt:rank=2:at_step=4:grace=30;"
+                   "worker:add:rank=0:at_step=20:count=1")
+        disco = FixedHosts({"w3a": 1, "w3b": 1, "w3c": 1})
+        box = {}
+        results, ok = elastic_run(
+            _train_body(box, 60, collect_stats=True), np=3, min_np=2,
+            max_np=3, discovery=disco, timeout=120, extra_env=FAST_HEALTH)
+        assert ok, results.error_message
+        log = box["log"]
+        worlds = [w for row in log for w in (row[1],)]
+        assert 2 in worlds and worlds[-1] == 3, worlds
+        # the grow back to world=3 re-forms into a shape both survivors
+        # shelved at the shrink: the first post-re-form plan build must
+        # graft a shelved compiled stage
+        assert log[-1][3] > 0, f"no warm plan reuse: {log[-1]}"
+
+    def test_preempt_loses_zero_steps_crash_loses_at_most_one(
+            self, fault_spec):
+        """The ISSUE 14 SLO pair: a graceful preemption (drain + grace +
+        slot-lost exit) rolls back nothing, while an abrupt kill loses
+        at most the one in-flight step (commit-per-step)."""
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.loopback import elastic_run
+
+        # the crash is keyed on the ROUND, not a step count: under a
+        # loaded box the preempt's re-form can take arbitrarily many
+        # step-times, and a step-keyed crash racing it merges the two
+        # transitions — at_round=2:after=5 fires deterministically on
+        # rank 1's 6th commit INSIDE the post-preempt world
+        fault_spec("worker:preempt:rank=2:at_step=4:grace=30;"
+                   "worker:crash:rank=1:at_round=2:after=5")
+        disco = FixedHosts({"pz0": 1, "pz1": 1, "pz2": 1})
+        box = {}
+        # min_np=1: after the crash only one host remains un-blacklisted,
+        # and the job must finish there rather than wait for slots
+        results, ok = elastic_run(
+            _train_body(box, 40, collect_stats=True), np=3, min_np=1,
+            max_np=3, discovery=disco, timeout=120, extra_env=FAST_HEALTH)
+        assert ok, results.error_message
+        log = box["log"]
+        worlds = [row[1] for row in log]
+        assert worlds[0] == 3 and worlds[-1] == 1, worlds
+        # per-transition steps-lost deltas off the registry counter
+        lost_at = {}
+        for i in range(1, len(log)):
+            if log[i][1] != log[i - 1][1]:
+                lost_at[(log[i - 1][1], log[i][1])] = \
+                    log[i][4] - log[i - 1][4]
+        # preempt: 3 -> 2 with zero rolled-back steps; crash: 2 -> re-form
+        # (2, with the dead host replaced or 2->2 restore) loses <= 1.
+        assert lost_at, log
+        assert (3, 2) in lost_at, (lost_at, worlds)  # preempt re-formed
+        assert lost_at[(3, 2)] == 0, (lost_at, log)
+        total_lost = log[-1][4]
+        assert total_lost <= 1, (total_lost, lost_at)
+        # committed steps never replay
+        steps = [row[0] for row in log]
+        assert steps == sorted(set(steps)), "committed steps replayed"
+
+
+# ---------------------------------------------------------------------------
+# driver-side grace + stale-report hygiene
+# ---------------------------------------------------------------------------
+
+class TestDriverChurnPlumbing:
+    def test_fixed_hosts_mutators(self):
+        from horovod_tpu.elastic.discovery import FixedHosts
+        fh = FixedHosts({"a": 1})
+        fh.add_hosts({"b": 2})
+        assert fh.find_available_hosts_and_slots() == {"a": 1, "b": 2}
+        assert fh.remove_host("a") is True
+        assert fh.remove_host("a") is False
+        assert fh.find_available_hosts_and_slots() == {"b": 2}
+
+    def test_scripted_churn_handler(self, monkeypatch):
+        from horovod_tpu.elastic.discovery import FixedHosts, ScriptedChurn
+        fh = FixedHosts({"h0": 1})
+        events = []
+        churn = ScriptedChurn(fh, events=events)
+        (add,) = _faults.parse_spec("worker:add:count=2")
+        churn("add", add)
+        hosts = fh.find_available_hosts_and_slots()
+        assert hosts == {"h0": 1, "churn0": 1, "churn1": 1}
+        monkeypatch.setenv("HVD_HOSTNAME", "churn0")
+
+        class _Driver:
+            grace = None
+
+            def set_stale_grace(self, host, s):
+                _Driver.grace = (host, s)
+
+        churn.attach_driver(_Driver())
+        (pre,) = _faults.parse_spec("worker:preempt:grace=7")
+        churn("preempt", pre)
+        assert _Driver.grace == ("churn0", 7.0)
+        assert "churn0" not in fh.find_available_hosts_and_slots()
+        assert [e[1] for e in events] == ["add", "preempt"]
+
+    def test_stale_round_peer_report_ignored(self):
+        """A peer-failure report resolved against a superseded round's
+        rank numbering must not blacklist the innocent successor that
+        inherited the rank number (the scripted-churn misattribution)."""
+        import pickle
+
+        from horovod_tpu.elastic import driver as drv
+
+        class _KV(dict):
+            def put(self, k, v):
+                self[k] = v
+
+            def get(self, k):
+                return dict.get(self, k)
+
+        recorded = []
+
+        class _Registry:
+            def record_failure(self, host, slot):
+                recorded.append((host, slot))
+
+        d = drv.ElasticDriver.__new__(drv.ElasticDriver)
+        d._rendezvous = drv.ElasticRendezvous(_KV())
+        d._rendezvous._round = 2
+        d._worker_registry = _Registry()
+        d._result_threads = []
+        # round 1 had rank 2 on oldhost; round 2 reassigned rank 2 to
+        # newhost (the replacement)
+        d._rendezvous.kv.put(
+            drv.ROUND_SPEC_KEY.format(1),
+            pickle.dumps({"round": 1, "slots": [
+                {"hostname": "oldhost", "rank": 2, "size": 3,
+                 "local_rank": 0, "local_size": 1, "cross_rank": 2,
+                 "cross_size": 3}]}))
+        d._rank_assignments = {2: drv.slot_from_dict(
+            {"hostname": "newhost", "rank": 2, "size": 3,
+             "local_rank": 0, "local_size": 1, "cross_rank": 2,
+             "cross_size": 3})}
+        d.record_peer_failure(2, "silence", round_id=1)
+        assert recorded == []  # stale report: hostnames differ -> ignored
+        # a CURRENT-round report still records
+        d.record_peer_failure(2, "silence", round_id=2)
+        for t in d._result_threads:
+            t.join(5)
+        assert recorded == [("newhost", 0)]
+
+    def test_resume_after_shutdown_noops(self):
+        from horovod_tpu.elastic import driver as drv
+        d = drv.ElasticDriver.__new__(drv.ElasticDriver)
+        d._shutdown = threading.Event()
+        d._shutdown.set()
+        d.resume()  # must not raise / touch worker machinery
+
+
+# ---------------------------------------------------------------------------
+# ResponseCache: warm shelf mechanics + join-race typed error
+# ---------------------------------------------------------------------------
+
+class TestResponseCacheWarm:
+    def _entry(self, name="t", world=2):
+        from horovod_tpu.dynamic import Response
+        req = {"name": name, "request_type": REQ_ALLREDUCE, "dtype": 0,
+               "element_size": 4, "shape": (4,)}
+        resp = Response(type=REQ_ALLREDUCE, tensor_names=[name])
+        return req, resp
+
+    def test_warm_restore_confirm_and_serve_gate(self):
+        from horovod_tpu.negotiation.response_cache import ResponseCache
+        rc = ResponseCache(8)
+        req, resp = self._entry()
+        rc.note_response(req, resp)
+        exported = rc.export_entries()
+        assert len(exported) == 0  # unconfirmed entries don't shelve
+        resp.from_cache = True
+        rc.note_response(req, resp)
+        exported = rc.export_entries()
+        assert len(exported) == 1
+
+        rc2 = ResponseCache(8)
+        assert rc2.restore_warm(exported) == 1
+        assert rc2.warm_count() == 1
+        # warm entries are present but NOT serveable pre-confirmation
+        assert rc2.lookup_confirmed(req) is None
+        assert rc2.confirm_warm() == 1
+        assert rc2.warm_count() == 0
+        assert rc2.lookup_confirmed(req) is not None
+
+    def test_warm_digest_agreement_and_empty_marker(self):
+        from horovod_tpu.negotiation.response_cache import ResponseCache
+        req, resp = self._entry()
+        resp.from_cache = True
+        a, b, fresh = ResponseCache(8), ResponseCache(8), ResponseCache(8)
+        a.note_response(req, resp)
+        b.note_response(req, resp)
+        a2, b2 = ResponseCache(8), ResponseCache(8)
+        a2.restore_warm(a.export_entries())
+        b2.restore_warm(b.export_entries())
+        assert a2.warm_digest() == b2.warm_digest()
+        assert fresh.warm_digest() == b"\x00" * 8  # the fresh-member veto
+        assert a2.warm_digest() != fresh.warm_digest()
+        assert b2.drop_warm() == 1
+        assert b2.warm_count() == 0
+
+    def test_shelf_lru_and_take(self):
+        from horovod_tpu.negotiation import response_cache as rcm
+        rcm.clear_shelf()
+        try:
+            rcm.shelve(("s", "global", 2, 0), [("n", ("sig",), None)])
+            assert rcm.take_shelved(("s", "global", 2, 0)) is not None
+            assert rcm.take_shelved(("s", "global", 2, 0)) is None
+        finally:
+            rcm.clear_shelf()
+
+
+class _BarrierWorld:
+    """In-memory lockstep exchange for N in-process DynamicServices
+    (the test_negotiation fixture, re-used for the join-race test)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.cond = threading.Condition()
+        self.frames: dict = {}
+        self.closed = False
+
+    def exchange(self, rank, cycle, req, bits, timeout):
+        with self.cond:
+            fr = self.frames.setdefault(cycle, {})
+            fr[rank] = (req, bits)
+            self.cond.notify_all()
+            end = time.monotonic() + min(timeout, 30.0)
+            while len(fr) < self.n:
+                if self.closed:
+                    raise RuntimeError("barrier world closed")
+                if time.monotonic() > end:
+                    raise TimeoutError(f"cycle {cycle} incomplete")
+                self.cond.wait(0.2)
+            self.frames.pop(cycle - 2, None)
+            return ([fr[r][0] for r in range(self.n)],
+                    [fr[r][1] for r in range(self.n)])
+
+    def close(self):
+        with self.cond:
+            self.closed = True
+            self.cond.notify_all()
+
+
+class _BarrierTransport:
+    def __init__(self, world, rank):
+        self.world_mem = world
+        self.world_size = world.n
+        self.rank = rank
+
+    def exchange(self, cycle, req, bits, timeout):
+        return self.world_mem.exchange(self.rank, cycle, req, bits, timeout)
+
+
+class TestResponseCacheJoinRace:
+    def _services(self, monkeypatch, n=2):
+        from horovod_tpu.engine_service import DynamicService
+        monkeypatch.setenv("HVD_RESPONSE_CACHE", "1")
+        world = _BarrierWorld(n)
+        svcs = [DynamicService(NativeEngine(world_size=n, rank=r),
+                               _BarrierTransport(world, r))
+                for r in range(n)]
+        return world, svcs
+
+    def _negotiate_all(self, svcs, name):
+        results = [None] * len(svcs)
+        errors = []
+
+        def one(i):
+            try:
+                results[i] = svcs[i].negotiate(name, REQ_ALLREDUCE,
+                                               shape=(4,), timeout=30)
+            except Exception as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=one, args=(i,), daemon=True)
+              for i in range(len(svcs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(40)
+        assert not errors, errors
+        return results
+
+    def test_pre_join_serve_raises_typed_error(self, monkeypatch):
+        """Rank 0 serves a batch locally from its confirmed coordinator
+        cache in the same window rank 1's JOIN goes to the wire: the
+        cycle that first observes the JOIN must fail rank 0's service
+        with ResponseCacheJoinError NAMING rank 1 — not leave the
+        locally-served, never-scheduled collective to burn the exchange
+        deadline (ROADMAP protocol follow-on (a))."""
+        world, svcs = self._services(monkeypatch)
+        try:
+            # steady state: confirm + begin serving locally
+            for _ in range(12):
+                self._negotiate_all(svcs, "g")
+                if all(s.response_cache_stats()["confirmed"] >= 1
+                       for s in svcs):
+                    break
+            assert all(s.response_cache_stats()["confirmed"] >= 1
+                       for s in svcs)
+            self._negotiate_all(svcs, "g")  # served locally everywhere
+
+            # rank 1 joins while rank 0 serves the same window locally
+            join_exc = []
+
+            def joiner():
+                try:
+                    svcs[1].join("j.join", timeout=20)
+                except Exception as e:  # the abort fails the join too
+                    join_exc.append(e)
+
+            jt = threading.Thread(target=joiner, daemon=True)
+            jt.start()
+            # rank 0's local serve needs no peer: it returns immediately
+            t0 = time.monotonic()
+            ticket = svcs[0].negotiate_many_submit([dict(
+                name="g", request_type=REQ_ALLREDUCE, dtype=0,
+                element_size=4, shape=(4,), root_rank=-1, group_id=-1,
+                splits=(), reduce_op=-1, prescale=1.0, postscale=1.0,
+                splits_crc=0)])
+            assert ticket.served, "serve did not happen pre-join"
+            svcs[0].negotiate_many_wait(ticket, timeout=30)
+            # rank 0's next REAL negotiation observes the failure fast
+            with pytest.raises(ResponseCacheJoinError) as ei:
+                for _ in range(40):
+                    svcs[0].negotiate(f"after.{_}", REQ_ALLREDUCE,
+                                      shape=(4,), timeout=30)
+                    time.sleep(0.05)
+            assert time.monotonic() - t0 < 20.0
+            assert "rank 1" in str(ei.value)
+            assert ei.value.joining_rank == 1
+            jt.join(10)
+        finally:
+            world.close()
+            for s in svcs:
+                s.stop()
+
+    def test_join_without_serves_latches_quietly(self, monkeypatch):
+        """A JOIN observed with no pre-join local serves just latches —
+        no typed error, the normal join semantics."""
+        world, svcs = self._services(monkeypatch)
+        try:
+            self._negotiate_all(svcs, "q")  # real rounds only, no serving
+            results = [None, None]
+
+            def joiner(i):
+                results[i] = svcs[i].join(f"q.join.{i}", timeout=30)
+
+            ts = [threading.Thread(target=joiner, args=(i,), daemon=True)
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(40)
+            assert results[0] is not None and results[1] is not None
+            for s in svcs:
+                assert s._failure is None
+        finally:
+            world.close()
+            for s in svcs:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# request-frame parsing (the join-race scanner's wire twin)
+# ---------------------------------------------------------------------------
+
+class TestParseRequests:
+    def test_roundtrip_via_native_pop(self):
+        from horovod_tpu.dynamic import parse_requests
+        eng = NativeEngine(world_size=2, rank=1)
+        eng.enqueue("a", REQ_ALLREDUCE, dtype=1, element_size=4,
+                    shape=(3, 2), reduce_op=0)
+        eng.enqueue("b.join", REQ_JOIN)
+        reqs = parse_requests(eng.pop_requests())
+        assert [(r["rank"], r["request_type"], r["name"]) for r in reqs] \
+            == [(1, REQ_ALLREDUCE, "a"), (1, REQ_JOIN, "b.join")]
+
+    def test_empty(self):
+        from horovod_tpu.dynamic import parse_requests
+        assert parse_requests(b"") == []
+
+
+# ---------------------------------------------------------------------------
+# dispatch-cache shelf unit coverage
+# ---------------------------------------------------------------------------
+
+class TestDispatchShelf:
+    def test_restorable_filter(self):
+        from horovod_tpu.ops import dispatch_cache as dc
+        plan = dc.DispatchPlan("l", "A", 1, None, lambda t: t)
+        assert dc._restorable(("allreduce", "n", ("r",), None, "g", 1),
+                              plan)
+        assert dc._restorable(("allreduce", "n", ("r",), None, 0, 1),
+                              plan)  # the registered GLOBAL set (id 0)
+        assert dc._restorable(("allreduce", "n", ("r",), None, (0, 1), 1),
+                              plan)  # self-describing rank tuple
+        assert not dc._restorable(
+            ("allreduce", "n", ("r",), None, 3, 1), plan)  # other ids
+        assert not dc._restorable(("k",), dc.UNPLANNABLE)
+
+    def test_stats_expose_warm_fields(self):
+        from horovod_tpu.ops import dispatch_cache as dc
+        st = dc.stats()
+        assert "warm_pool" in st and "warm_reuses" in st

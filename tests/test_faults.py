@@ -637,7 +637,9 @@ class TestElasticChaos:
             parsed = health.parse_peer_failure(
                 health.peer_failure_key(0),
                 h.kv.get(health.peer_failure_key(0)))
-            assert parsed == (1, "no beat for 1.0s")
+            # legacy records (no round tag) parse with round_id=-1 and
+            # keep the pre-ISSUE-14 resolve-against-current behavior
+            assert parsed == (1, "no beat for 1.0s", -1)
             h.driver.record_peer_failure(*parsed)
             h.wait_round(2, timeout=10.0)
             deadline = time.monotonic() + 5.0

@@ -180,7 +180,9 @@ class TestLegacyViews:
         assert set(s) == {"enabled", "capacity", "size", "hits",
                           "hits_by_source", "misses", "invalidations",
                           "evictions", "negotiation_skips",
-                          "chunked_builds", "step_builds"}
+                          "chunked_builds", "step_builds",
+                          # ISSUE 14: elastic warm re-form pool/grafts
+                          "warm_pool", "warm_reuses"}
         assert set(s["hits_by_source"]) >= {"call", "flush", "step"}
         assert s["hits"] == sum(s["hits_by_source"].values())
 

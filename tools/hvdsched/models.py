@@ -680,6 +680,99 @@ def loopback_exchange_unguarded():
     for t in ts:
         inv.join_thread(t)
 
+def elastic_reform():
+    """Elastic re-form clean matrix (ISSUE 14): a worker commit loop, a
+    peer-death report recording a registry failure, and the driver's
+    resume publishing the next round — all racing a waiter blocked on
+    the round advance. Models the driver shape (`_round_lock` +
+    `_wait_hosts_cond` + registry-driven resume): every transition is an
+    atomic check-and-wait under the round condition, so exploration must
+    find no schedule where the blocked waiter misses the round notify or
+    two resumes publish the same round twice."""
+    inv = _inv()
+    round_cv = inv.make_condition("reform.round_cv")
+    round_lock = inv.make_lock("reform.round_lock")
+    state = {"round": 1, "failures": 0, "published": []}
+
+    def publish_resume():
+        # the driver's _activate_workers: round transitions serialize on
+        # the round lock; publication and notify are atomic under the cv
+        with round_lock:
+            with round_cv:
+                state["round"] += 1
+                state["published"].append(state["round"])
+                round_cv.notify_all()
+
+    def commit_waiter():
+        # a worker blocked in its reset waiting for the next round: the
+        # check and the wait are atomic under the condition (the
+        # guarded twin of the stale-plan demo's bug shape)
+        with round_cv:
+            while state["round"] < 2:
+                if not round_cv.wait(30.0):
+                    raise AssertionError(
+                        "blocked waiter missed the round notify")
+
+    def peer_death_reporter():
+        # bootstrap observer path: record failure, then resume NOW
+        state["failures"] += 1
+        publish_resume()
+
+    def discovery_resume():
+        # discovery-thread path: a host change resumes concurrently
+        publish_resume()
+
+    ts = [inv.spawn_thread(commit_waiter, name="commit-waiter"),
+          inv.spawn_thread(peer_death_reporter, name="peerfail-report"),
+          inv.spawn_thread(discovery_resume, name="disco-resume")]
+    for t in ts:
+        inv.join_thread(t)
+    if state["round"] != 3:
+        raise AssertionError(f"rounds lost/duplicated: {state}")
+    if state["published"] != [2, 3]:
+        raise AssertionError(f"non-monotonic publication: {state}")
+
+
+def stale_plan_after_resize_demo():
+    """PLANTED stale-plan-after-resize (ISSUE 14): a dispatch-plan cache
+    keyed WITHOUT the process-set shape, read outside the resize lock —
+    a schedule where the elastic resize lands between the worker's cache
+    read and its execute serves a plan compiled for the OLD world size,
+    the exact staleness class the shape-keyed shelve/restore in
+    ``ops/dispatch_cache.py`` (docs/elastic.md) closes by construction.
+    Most schedules pass; exploration must FIND the window and the
+    model-assertion finding replays byte-for-byte from (seed, trace)."""
+    inv = _inv()
+    mu = inv.make_lock("staleplan.mu")
+    world = {"size": 4}
+    cache: dict = {}
+
+    def worker():
+        # BUG: the plan key ignores the world shape and the read is not
+        # atomic with the execute — a resize in between serves a plan
+        # compiled for the old world
+        if "allreduce" not in cache:
+            with mu:
+                cache["allreduce"] = {"compiled_world": world["size"]}
+        plan = cache["allreduce"]
+        if plan["compiled_world"] != world["size"]:
+            raise AssertionError(
+                f"stale plan served: compiled for world "
+                f"{plan['compiled_world']}, executing at world "
+                f"{world['size']}")
+
+    def resizer():
+        # elastic re-form: the world shrinks; the cache SHOULD have been
+        # shelved by shape, but this model's key has no shape to match
+        with mu:
+            world["size"] = 2
+
+    ts = [inv.spawn_thread(worker, name="worker"),
+          inv.spawn_thread(resizer, name="resizer")]
+    for t in ts:
+        inv.join_thread(t)
+
+
 def deadlock_demo():
     """Classic two-lock inversion: T1 takes a then b, T2 takes b then
     a. Some schedules deadlock; the report must name both locks — the
@@ -778,6 +871,7 @@ MATRIX = {
     "loopback-exchange": loopback_exchange,
     "pr3-issue-lock": pr3_issue_lock,
     "pr6-chain-guard": pr6_chain_guard,
+    "elastic-reform": elastic_reform,
 }
 
 DEMOS = {
@@ -788,6 +882,7 @@ DEMOS = {
     "qos-inversion-demo": qos_inversion_demo,
     "pr3-unguarded": pr3_unguarded,
     "pr6-unguarded": pr6_unguarded,
+    "stale-plan-after-resize-demo": stale_plan_after_resize_demo,
 }
 
 MODELS = {**MATRIX, **DEMOS}
