@@ -1975,6 +1975,236 @@ def run_elastic_bench(args):
     }))
 
 
+def run_autoscale_bench(args):
+    """Closed-loop elastic autoscaling end to end (docs/elastic.md
+    "Autoscaler"; ISSUE 15 — BENCH_r15). Three loopback phases, none of
+    them scripted — every membership change below is DECIDED by the
+    ``HVD_AUTOSCALE`` policy from the metrics-registry sensors:
+
+    * **load** (floor 2, ceiling 3): a fixed offered load shared by the
+      world — heavy enough to breach the step-time SLO at the floor,
+      under it at 3 — ramps in, breaches, then drops to idle. Gates:
+      the policy scales UP within the latency budget of the breach
+      starting (no script fired it), scales DOWN after sustained idle
+      with ZERO steps lost (the PR-14 grace path), and the run ends at
+      the floor.
+    * **evict** (world 3): a fault-injected slow rank (``svc.exchange``
+      delay, round-1-keyed so the replacement never inherits it) is
+      blamed by the StragglerTracker windows, EVICTED through the grace
+      window and replaced in the same re-form — the decision instrument
+      names the planted rank, zero steps lost, warm shelves apply to
+      the replacement's world.
+    * **flap** (floor 2): an adversarial load alternating breach/idle
+      faster than the hysteresis streaks — the oscillation bound: at
+      most one membership decision over the whole phase (expected
+      zero; +1 absorbs a pathological box stall aligning windows).
+    """
+    from horovod_tpu.loopback.engine import _seed_xla_device_flags
+
+    _seed_xla_device_flags(4)
+
+    from horovod_tpu.utils import faults
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.loopback import elastic_run
+
+    base_env = {
+        "HVD_HEALTH_INTERVAL": "0.3",
+        "HVD_HEALTH_TIMEOUT": "6",
+        "HVD_AUTOSCALE": "1",
+        "HVD_AUTOSCALE_INTERVAL": "0.4",
+        "HVD_AUTOSCALE_COOLDOWN": "3",
+        "HVD_AUTOSCALE_GRACE": "30",
+    }
+
+    def phase(name, body_fn, hosts, np_, min_np, max_np, env, spec=None):
+        os.environ.pop("HVD_FAULT_SPEC", None)
+        if spec:
+            os.environ["HVD_FAULT_SPEC"] = spec
+        faults.refresh()
+        disco = FixedHosts(dict(hosts))
+        box, abox = {}, {}
+        results, ok = elastic_run(
+            body_fn(box), np=np_, min_np=min_np, max_np=max_np,
+            discovery=disco, timeout=180,
+            extra_env=dict(base_env, **env), autoscale_box=abox)
+        return (box.get("log") or [], abox.get("decisions") or [], ok,
+                results.error_message)
+
+    def make_body(total, sleep_of, collect_warm=False):
+        def factory(box):
+            def body():
+                import horovod_tpu as _hvd
+                _hvd.init()
+                state = _hvd.elastic.JaxState(step=0, log=[])
+
+                @_hvd.elastic.run
+                def train(state):
+                    from horovod_tpu import metrics as _metrics
+                    from horovod_tpu.ops import dispatch_cache
+                    while state.step < total:
+                        out = _hvd.allreduce(jnp.arange(4.0) + 1.0,
+                                             op=_hvd.Sum, name="w")
+                        # element 0 of sum(arange(4)+1) over `world`
+                        # identical contributions is exactly world;
+                        # element 1 is 2*world (the numerics check)
+                        world = int(float(np.asarray(out).reshape(-1)[0]))
+                        p1 = float(np.asarray(out).reshape(-1)[1])
+                        if _hvd.rank() == 0:
+                            state.log = state.log + [(
+                                time.monotonic(), state.step, world, p1,
+                                int(_metrics.ELASTIC_STEPS_LOST.value()),
+                                dispatch_cache.stats()["warm_reuses"]
+                                if collect_warm else 0)]
+                        time.sleep(sleep_of(state.step, world))
+                        state.step += 1
+                        state.commit()
+                    return state.log
+
+                log = train(state)
+                if _hvd.rank() == 0:
+                    box["log"] = log
+                return 0
+
+            return body
+        return factory
+
+    def numerics_of(log):
+        return all(abs(p1 - 2.0 * world) < 1e-6
+                   for (_t, _s, world, p1, *_r) in log)
+
+    t0 = time.monotonic()
+
+    # -- phase 1: ramp -> breach -> idle ------------------------------------
+    RAMP, BREACH_END, TOTAL = 8, 60, 230
+    LOAD, LIGHT, SLO_MS = 0.60, 0.02, 220.0
+
+    def load_sleep(step, world):
+        if step < RAMP:
+            return LIGHT
+        if step < BREACH_END:
+            return LOAD / max(world, 1)  # 300 ms at 2, 200 ms at 3
+        return LIGHT
+
+    load_log, load_dec, load_ok, load_err = phase(
+        "load", make_body(TOTAL, load_sleep), {"l0": 1, "l1": 1},
+        2, 2, 3, {
+            "HVD_RESPONSE_CACHE": "1",
+            "HVD_AUTOSCALE_SLO_MS": str(SLO_MS),
+            "HVD_AUTOSCALE_BREACH_WINDOWS": "2",
+            "HVD_AUTOSCALE_IDLE_WINDOWS": "3",
+            "HVD_AUTOSCALE_IDLE_FACTOR": "0.6",
+        })
+
+    # -- phase 2: straggler eviction ----------------------------------------
+    evict_log, evict_dec, evict_ok, evict_err = phase(
+        "evict", make_body(46, lambda s, w: 0.0, collect_warm=True),
+        {"e0": 1, "e1": 1, "e2": 1}, 3, 2, 4, {
+            "HVD_RESPONSE_CACHE": "0",  # busy rounds feed the tracker
+            "HVD_STRAGGLER_THRESHOLD": "0.15",
+            "HVD_AUTOSCALE_EVICT_WINDOWS": "2",
+        }, spec="svc.exchange:delay=0.4:rank=2:at_round=1")
+
+    # -- phase 3: adversarial flapping --------------------------------------
+    # Each load half must register as >= 1 policy window but flip before
+    # the 3-window streak requirement: heavy = 3 steps x ~300 ms
+    # (~2.2 windows at the 0.4 s interval), light = 25 steps x ~20 ms
+    # (~1-2 windows with per-step overhead). Step-indexed, so the
+    # pattern is rank-symmetric by construction.
+    FLAP_HEAVY, FLAP_LIGHT = 3, 25
+    FLAP_PERIOD = FLAP_HEAVY + FLAP_LIGHT
+    FLAP_TOTAL = 4 * FLAP_PERIOD
+
+    def flap_sleep(step, world):
+        heavy = (step % FLAP_PERIOD) < FLAP_HEAVY
+        return (LOAD / max(world, 1)) if heavy else LIGHT
+
+    flap_log, flap_dec, flap_ok, flap_err = phase(
+        "flap", make_body(FLAP_TOTAL, flap_sleep), {"f0": 1, "f1": 1},
+        2, 2, 3, {
+            "HVD_RESPONSE_CACHE": "1",
+            "HVD_AUTOSCALE_SLO_MS": str(SLO_MS),
+            "HVD_AUTOSCALE_BREACH_WINDOWS": "3",
+            "HVD_AUTOSCALE_IDLE_WINDOWS": "3",
+            "HVD_AUTOSCALE_IDLE_FACTOR": "0.6",
+        })
+    elapsed = time.monotonic() - t0
+
+    err = None
+    if not (load_ok and load_log):
+        err = f"load phase: {load_err or 'no rank-0 log'}"
+    elif not (evict_ok and evict_log):
+        err = f"evict phase: {evict_err or 'no rank-0 log'}"
+    elif not (flap_ok and flap_log):
+        err = f"flap phase: {flap_err or 'no rank-0 log'}"
+    if err is not None:
+        print(json.dumps({"metric": "elastic_autoscale_closed_loop",
+                          "value": None, "error": err[:500]}))
+        return
+
+    def acted(decisions):
+        return [d for d in decisions if d["action"] != "hold"]
+
+    # scale-up latency: breach start (first heavy step's wall time) to
+    # the add decision — decisions and the step log share one monotonic
+    # clock (driver and workers live in one loopback interpreter)
+    breach_t0 = next((t for (t, s, *_r) in load_log if s >= RAMP), None)
+    adds = [d for d in load_dec
+            if d["action"] == "add" and d["reason"] == "slo-breach"]
+    removes = [d for d in load_dec
+               if d["action"] == "remove" and d["reason"] == "idle"]
+    scale_up_latency_s = (round(adds[0]["t"] - breach_t0, 2)
+                          if adds and breach_t0 is not None else None)
+    load_worlds = [w for (_t, _s, w, *_r) in load_log]
+    # steps lost across the idle scale-down (rank-0 counter deltas)
+    down_lost = None
+    for i in range(1, len(load_log)):
+        if load_log[i][2] < load_log[i - 1][2]:
+            down_lost = load_log[i][4] - load_log[i - 1][4]
+    evicts = [d for d in evict_dec if d["action"] == "evict"]
+    evict_worlds = [w for (_t, _s, w, *_r) in evict_log]
+
+    print(json.dumps({
+        "metric": "elastic_autoscale_closed_loop",
+        "value": scale_up_latency_s,
+        "unit": "seconds from SLO-breach load onset to the policy's "
+                "un-scripted scale-up decision (sensor windows + "
+                "hysteresis included); the other gates ride the "
+                "phase blocks",
+        "slo_ms": SLO_MS,
+        "load": {
+            "worlds": sorted(set(load_worlds)),
+            "final_world": load_worlds[-1],
+            "scale_up_latency_s": scale_up_latency_s,
+            "scale_down_steps_lost": down_lost,
+            "steps_lost_total": load_log[-1][4],
+            "decisions": [(d["action"], d["reason"]) for d in
+                          acted(load_dec)],
+        },
+        "evict": {
+            "worlds": sorted(set(evict_worlds)),
+            "final_world": evict_worlds[-1],
+            "decisions": [(d["action"], d["reason"], d["rank"])
+                          for d in acted(evict_dec)],
+            "evicted_rank": evicts[0]["rank"] if evicts else None,
+            "steps_lost_total": evict_log[-1][4],
+            "warm_reuses": evict_log[-1][5],
+        },
+        "flap": {
+            "decisions": [(d["action"], d["reason"]) for d in
+                          acted(flap_dec)],
+            "membership_decisions": len(acted(flap_dec)),
+            "worlds": sorted(set(w for (_t, _s, w, *_r) in flap_log)),
+        },
+        "elapsed_s": round(elapsed, 1),
+        "numerics_ok": bool(numerics_of(load_log)
+                            and numerics_of(evict_log)
+                            and numerics_of(flap_log)),
+        "baseline": "PR-14 scripted churn: the identical membership "
+                    "mechanics fired by a schedule; here every action "
+                    "is policy-decided from the registry sensors",
+    }))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=256,
@@ -2203,6 +2433,15 @@ def main():
                              "phase of --elastic-bench (replaces the "
                              "scheduled graceful default; the abrupt "
                              "phase keeps its own schedule)")
+    parser.add_argument("--autoscale-bench", action="store_true",
+                        help="closed-loop elastic autoscaling at a "
+                             "loopback world (docs/elastic.md "
+                             "'Autoscaler'; BENCH_r15): an un-scripted "
+                             "SLO breach triggers a policy scale-up, "
+                             "sustained idle a zero-loss scale-down, a "
+                             "fault-injected slow rank is evicted and "
+                             "named, and adversarial flapping produces "
+                             "no oscillation")
     parser.add_argument("--serve-bench", action="store_true",
                         help="run the multi-tenant inference-serving QoS "
                              "benchmark (CPU backend, no accelerator "
@@ -2271,6 +2510,8 @@ def main():
         return run_serve_bench(args)
     if args.elastic_bench:
         return run_elastic_bench(args)
+    if args.autoscale_bench:
+        return run_autoscale_bench(args)
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
         # Claiming the backend ourselves now would either fail identically

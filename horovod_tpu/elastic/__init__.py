@@ -45,6 +45,7 @@ from .discovery import (
 )
 from .driver import ElasticDriver, ElasticRendezvous, Results
 from .notification import WorkerNotificationManager, notification_manager
+from .policy import AutoscalePolicy, PolicyEvalError
 from .registration import WorkerStateRegistry
 from .sampler import ElasticSampler
 from .state import HostUpdateResult, JaxState, ObjectState, State, run_fn
@@ -84,6 +85,7 @@ def run(func):
 
 
 __all__ = [
+    "AutoscalePolicy", "PolicyEvalError",
     "ElasticDriver", "ElasticRendezvous", "FixedHosts", "HorovodInternalError",
     "HostDiscovery", "HostDiscoveryScript", "HostManager", "HostUpdateResult",
     "HostsUpdatedInterrupt", "JaxState", "ObjectState", "Results", "State",

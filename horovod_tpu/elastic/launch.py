@@ -99,11 +99,23 @@ def run_elastic(args, command: list[str]) -> int:
             extra=infra.worker_extra_env(spec_round, extra_base))
         return launch_mod.spawn_worker(slot_info, command, env, args)
 
+    # Closed-loop autoscaling (docs/elastic.md "Autoscaler"): the
+    # driver-side policy reads worker sensor blobs off the launcher KV
+    # and mutates the discovery set; works with FixedHosts-backed
+    # discovery (the add/remove seam) — script-discovered host sets
+    # stay authoritative and the policy warns itself off.
+    from . import policy as _policy_mod
+    autoscaler = _policy_mod.maybe_start(
+        driver, discovery, infra.kv, min_np=min_np, max_np=max_np,
+        env=extra_base)
+
     try:
         driver.start(args.np or min_np, create_worker_fn)
         driver.join()
         results = driver.get_results()
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if churn is not None:
             from ..utils import faults as _faults
             _faults.clear_membership_handler()

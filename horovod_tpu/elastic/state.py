@@ -22,6 +22,7 @@ import queue
 
 from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils import faults as _faults
+from . import policy as _policy
 
 
 class HostUpdateResult(enum.IntFlag):
@@ -73,6 +74,10 @@ class State:
         # whole elastic recovery chain (watchdog -> PeerFailureError ->
         # blacklist -> re-formed round). No-op with HVD_FAULT_SPEC unset.
         _faults.inject("worker", rank=self._rank(), step=self._commits)
+        # Autoscale sensor seam (docs/elastic.md): the commit boundary
+        # is the per-step clock the policy's SLO rule watches. No-op
+        # with HVD_AUTOSCALE unset (cached observer miss).
+        _policy.note_commit()
         self.save()
         self.check_host_updates()
 

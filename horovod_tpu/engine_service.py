@@ -655,6 +655,27 @@ class DynamicService:
             owed = sorted(self._pending)
         exc = _health.make_peer_failure_error(dead_rank, reason, owed)
         _timeline.record_health_event(f"PEER_DEAD.{dead_rank}")
+        # A failure decision on a peer that announced a GRACEFUL
+        # departure is not a broken world — owed work still fails fast
+        # below, but the confirmed coordinator-cache entries (proven
+        # coherent at their confirm cycles; re-proven by the successor's
+        # digest round regardless) shelve like a clean re-form teardown
+        # would. Without this, one slow survivor crossing the silence
+        # timeout on an already-left peer cold-started the ENTIRE next
+        # world: its missing shelf made its digest the empty veto
+        # (observed at world=8 churn — docs/elastic.md "Warm re-form").
+        # Shelve BEFORE _fail_all: the abort invalidates the cache.
+        if (self._rcache is not None and self._failure is None
+                and envs.elastic_warm_enabled()
+                and self._watchdog is not None
+                and self._watchdog.peer_left(dead_rank)):
+            items = self._rcache.export_entries()
+            if items:
+                _rcache.shelve(self._rc_shape_key, items)
+                hvd_logging.info(
+                    "response cache: shelved %d entries at graceful-"
+                    "departure failure (shape %s)", len(items),
+                    self._rc_shape_key)
         self._fail_all(str(exc), exc)
         from .ops import fusion_cycle
         aborted = fusion_cycle.abort(str(exc))

@@ -474,6 +474,21 @@ class HealthWatchdog:
 
     # -- introspection -----------------------------------------------------
 
+    def peer_left(self, global_rank: int) -> bool:
+        """Whether ``global_rank`` announced a GRACEFUL departure (the
+        ``left/<rank>`` marker) before this watchdog's failure decision.
+        The engine service consults this to type its failure: owed work
+        is failed fast either way, but a departure is not a *broken*
+        world — shape-keyed warm state (whose coherence the successor's
+        digest round re-proves) may still shelve (docs/elastic.md; the
+        world>4 churn runs surfaced exactly this: a slow survivor
+        crossing the silence timeout on an already-departed peer vetoed
+        the shelve and cascaded into a cold re-form for everyone)."""
+        with self._mu:
+            left = set(self._left)
+        return any(self.global_ranks[lr] == global_rank
+                   for lr in left if lr < len(self.global_ranks))
+
     def last_seen(self) -> dict[int, float | None]:
         """Seconds since each peer's beat counter last advanced, keyed by
         GLOBAL rank; None for a peer never seen beating."""
@@ -611,6 +626,24 @@ class StragglerTracker:
                 "warnings": self._warnings,
                 "last_warning": self._last_warning,
             }
+
+
+def straggler_blames() -> dict[int, int]:
+    """Cumulative straggler rounds THIS rank's trackers have charged to
+    each global rank, read off the metrics registry (the calling
+    thread's world store, so a loopback rank reports only its own
+    observations). The autoscale policy's eviction sensor
+    (docs/elastic.md): per-rank observers publish deltas of this view
+    and the driver-side policy aggregates the blames across reporters —
+    the seam between "rank N is slow" (StragglerTracker) and "replace
+    rank N" (AutoscalePolicy)."""
+    out: dict[int, int] = {}
+    for labelitems, v in _metrics.STRAGGLER_ROUNDS.series().items():
+        try:
+            out[int(dict(labelitems).get("rank"))] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return out
 
 
 # -- process-wide registry + the hvd.health_stats() surface -----------------

@@ -378,7 +378,8 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
                 max_np: int | None = None, discovery=None,
                 extra_env=None, timeout: float | None = None,
                 reset_limit: int | None = None,
-                churn_events: list | None = None):
+                churn_events: list | None = None,
+                autoscale_box: dict | None = None):
     """Run an elastic loopback job: the REAL ``ElasticDriver`` + registry
     + rendezvous + discovery, with workers as loopback rank threads.
     ``fn`` is the worker body (the full "script": it calls ``hvd.init()``
@@ -386,7 +387,10 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
     mirroring ``elastic/launch.run_elastic``'s decision inputs.
     ``churn_events`` (optional list) receives the ScriptedChurn event log
     — (monotonic seconds, action, host) per fired membership rule — when
-    ``HVD_FAULT_SPEC`` schedules churn (the elastic bench reads it)."""
+    ``HVD_FAULT_SPEC`` schedules churn (the elastic bench reads it).
+    ``autoscale_box`` (optional dict) receives the closed-loop policy's
+    decision log under ``"decisions"`` when ``HVD_AUTOSCALE=1``
+    (docs/elastic.md "Autoscaler"; the autoscale bench reads it)."""
     from ..elastic.bootstrap import make_elastic_infra
     from ..runner.launch import _free_port
     from ..utils import faults as _faults
@@ -433,6 +437,15 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
 
     if churn is not None:
         churn.attach_driver(driver)
+    # Closed-loop autoscaling (docs/elastic.md): with HVD_AUTOSCALE=1
+    # the driver-side policy reads per-rank sensor blobs off this
+    # world's KV and mutates the SAME discovery seam scripted churn
+    # uses. HVD_AUTOSCALE must also reach the worker overlays so the
+    # per-rank commit observers arm.
+    from ..elastic import policy as _policy_mod
+    autoscaler = _policy_mod.maybe_start(
+        driver, discovery, infra.kv, min_np=min_np or np, max_np=max_np,
+        env=base_env)
     try:
         _check_devices(max_np or np)
         driver.start(np, create_worker_fn)
@@ -440,6 +453,12 @@ def elastic_run(fn, *, np: int, min_np: int | None = None,
         results = driver.get_results()
         succeeded = driver.succeeded
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+            if autoscale_box is not None:
+                autoscale_box["decisions"] = [
+                    d.as_dict() for d in autoscaler.decisions]
+                autoscale_box["stats"] = autoscaler.policy_stats()
         if churn is not None:
             _faults.clear_membership_handler()
         infra.stop()

@@ -100,9 +100,24 @@ class _Entry:
 # one real round re-arms local serving (vs. two cold: populate+confirm).
 # --------------------------------------------------------------------------
 
+# Shelf keys are PER RANK — one world-W churn cycle keeps ~3W keys
+# live at once (W at the old size, W-1 at the new, W re-shelved while
+# the next round's takes are still draining). The floor covers small
+# worlds; past it the cap scales with the largest world currently
+# shelved, so a world-16 cycle cannot LRU-evict its own shapes
+# mid-cycle (the exact failure the ISSUE-15 world=16 run surfaced:
+# most ranks' digests went empty and vetoed the warm re-arm for all).
 _SHELF_SHAPES = 16
 _shelf_mu = threading.Lock()
 _shelf: "OrderedDict[tuple, list]" = OrderedDict()
+
+
+def _shelf_cap() -> int:
+    """Caller holds ``_shelf_mu``. Key layout: (scope, pset, world,
+    rank) — index 2 is the world size."""
+    worlds = [k[2] for k in _shelf
+              if len(k) > 2 and isinstance(k[2], int)]
+    return max(_SHELF_SHAPES, 4 * max(worlds, default=0))
 
 
 def shelve(shape: tuple, items: list) -> None:
@@ -112,7 +127,8 @@ def shelve(shape: tuple, items: list) -> None:
     with _shelf_mu:
         _shelf[shape] = items
         _shelf.move_to_end(shape)
-        while len(_shelf) > _SHELF_SHAPES:
+        cap = _shelf_cap()
+        while len(_shelf) > cap:
             _shelf.popitem(last=False)
 
 

@@ -112,11 +112,23 @@ _epoch: tuple | None = None
 # --------------------------------------------------------------------------
 
 # Shapes retained process-wide (LRU). One loopback elastic run touches
-# up to world_max shapes per size it visits (one per (size, rank)); 32
-# covers a 4..8-world churn history without evicting a shape mid-cycle.
+# up to world_max shapes per size it visits (one per (size, rank)): a
+# world-W churn cycle keeps ~3W shape keys live at once (W at the old
+# size, W-1 at the new, W re-shelved before the next round drains its
+# takes). The static floor covers small worlds; past it the cap scales
+# with the largest world currently shelved so a world-16 cycle cannot
+# evict its own shapes mid-cycle (ISSUE 15 shelf sizing).
 _SHELF_SHAPES = 32
 _shelf: "OrderedDict[tuple, dict]" = OrderedDict()
 _warm_plans: dict = {}  # non-loopback warm pool (loopback: ctx.warm_plans)
+
+
+def _shelf_cap() -> int:
+    """Caller holds ``_lock``. Shape layout: (scope, size, rank) —
+    index 1 is the world size."""
+    worlds = [k[1] for k in _shelf
+              if len(k) > 1 and isinstance(k[1], int)]
+    return max(_SHELF_SHAPES, 4 * max(worlds, default=0))
 
 
 def _current_shape() -> tuple | None:
@@ -182,7 +194,8 @@ def shelve_for_reform() -> int:
         else:
             _shelf[shape] = {"plans": keep, "epoch": epoch}
         _shelf.move_to_end(shape)
-        while len(_shelf) > _SHELF_SHAPES:
+        cap = _shelf_cap()
+        while len(_shelf) > cap:
             _shelf.popitem(last=False)
         return len(keep)
 

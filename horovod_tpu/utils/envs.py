@@ -52,6 +52,17 @@ DYNAMIC_ENGINE = "DYNAMIC_ENGINE"  # 0 disables multi-process negotiation
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
 ELASTIC_GRACE = "ELASTIC_GRACE"  # s a slot-removed worker gets to exit cleanly (0 = immediate kill)
 ELASTIC_WARM = "ELASTIC_WARM"  # auto|1|0: shape-keyed cache survival across elastic re-forms
+AUTOSCALE = "AUTOSCALE"  # closed-loop elastic autoscaling policy (0 = scripted/manual churn only)
+AUTOSCALE_SLO_MS = "AUTOSCALE_SLO_MS"  # step-time SLO target; 0 = breach/idle rules off (evict-only)
+AUTOSCALE_INTERVAL = "AUTOSCALE_INTERVAL"  # s per policy evaluation window
+AUTOSCALE_BREACH_WINDOWS = "AUTOSCALE_BREACH_WINDOWS"  # consecutive SLO-breach windows before scale-up
+AUTOSCALE_IDLE_WINDOWS = "AUTOSCALE_IDLE_WINDOWS"  # consecutive idle windows before graceful scale-down
+AUTOSCALE_EVICT_WINDOWS = "AUTOSCALE_EVICT_WINDOWS"  # consecutive windows blaming one straggler before eviction
+AUTOSCALE_COOLDOWN = "AUTOSCALE_COOLDOWN"  # s after any membership decision before the next may fire
+AUTOSCALE_MIN = "AUTOSCALE_MIN"  # world floor the policy never shrinks below (default: driver min_np)
+AUTOSCALE_MAX = "AUTOSCALE_MAX"  # world ceiling the policy never grows past (default: driver max_np)
+AUTOSCALE_GRACE = "AUTOSCALE_GRACE"  # s of slot-lost grace a policy departure (scale-down/evict) gets
+AUTOSCALE_IDLE_FACTOR = "AUTOSCALE_IDLE_FACTOR"  # fraction of the SLO below which a window counts as idle
 GLOO_TIMEOUT_SECONDS = "GLOO_TIMEOUT_SECONDS"  # KV transport op timeout
 SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # force sparse grads onto dense allreduce
 BUCKET_BYTES = "BUCKET_BYTES"  # gradient bucket size for backward-pass overlap (0 = whole-tree)
@@ -445,6 +456,43 @@ def hier_negotiation_enabled(world_size: int) -> bool:
     if val in ("0", "false", "no", "off"):
         return False
     return world_size > negotiation_group_size()
+
+
+# Closed-loop elastic autoscaling (elastic/policy.py, docs/elastic.md).
+# The 2 s evaluation window matches the health-beat default: membership
+# decisions ride the same "seconds, not negotiation deadlines" cadence.
+# Hysteresis defaults are asymmetric on purpose — growing is cheap and
+# reversible (3 breach windows), shrinking throws capacity away (5 idle
+# windows), and eviction replaces a live-but-slow worker (3 blame
+# windows, the StragglerTracker's own sustain default). The 15 s
+# cooldown spans a loopback re-form plus settle time, so one decision's
+# own disruption can never read as the next window's signal (the
+# oscillation bound tested by the adversarial flapping load).
+DEFAULT_AUTOSCALE_INTERVAL_S = 2.0
+DEFAULT_AUTOSCALE_BREACH_WINDOWS = 3
+DEFAULT_AUTOSCALE_IDLE_WINDOWS = 5
+DEFAULT_AUTOSCALE_EVICT_WINDOWS = 3
+DEFAULT_AUTOSCALE_COOLDOWN_S = 15.0
+DEFAULT_AUTOSCALE_GRACE_S = 30.0
+DEFAULT_AUTOSCALE_IDLE_FACTOR = 0.5
+
+
+def autoscale_enabled() -> bool:
+    """Closed-loop autoscaling (``elastic/policy.py``): the driver-side
+    policy decides ``add``/``remove``/``evict`` from the metrics-registry
+    sensors instead of a script. Off by default — scripted churn and
+    manual discovery stay the only membership sources."""
+    return get_bool(AUTOSCALE, False)
+
+
+def autoscale_slo_s() -> float:
+    """Step-time SLO target in SECONDS (knob is ms). 0 disables the
+    breach/idle rules — the policy then only evicts stragglers."""
+    return get_float(AUTOSCALE_SLO_MS, 0.0) / 1e3
+
+
+def autoscale_interval_s() -> float:
+    return get_float(AUTOSCALE_INTERVAL, DEFAULT_AUTOSCALE_INTERVAL_S)
 
 
 # Elastic warm re-form (docs/elastic.md): plan stores / step plans /

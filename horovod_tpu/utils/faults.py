@@ -18,6 +18,10 @@ Spec grammar (semicolon-separated rules)::
 
 * **site** — injection-point name (table in docs/robustness.md); a
   trailing ``*`` prefix-matches (``kv.*`` covers put/get/delete).
+  ``policy.eval`` fires inside the autoscale policy's evaluation
+  window (docs/elastic.md): an injected error there must degrade to a
+  counted ``hold`` decision, never a job failure — the policy's
+  failure-semantics contract, tested through exactly this seam.
 * **action** — ``error`` (raise :class:`FaultInjected`), ``crash``
   (``os._exit``; code via ``code=N``, default 1), ``delay=<seconds>``
   (sleep, then continue), or — at the ``worker`` site only — a

@@ -705,6 +705,55 @@ class TestRankDivergence:
         assert len(found) == 2, msgs
         assert "dynamic queue/tenant runtime state" in msgs
 
+    def test_trips_on_autoscale_policy_state(self, tmp_path):
+        # ISSUE 15: autoscale decisions are DRIVER-authoritative — a
+        # rank branching a collective on policy output (or on its own
+        # straggler observations feeding the policy) is the
+        # mismatched-collective hang class, exactly like rank()
+        src = """
+            import horovod_tpu as hvd
+
+            def policy_gated(h, pol):
+                if pol.policy_stats()["breach_streak"] > 0:
+                    h.allreduce_async([1.0], name="gated")
+
+            def decision_gated(h, pol, entry):
+                d = pol.last_decision
+                if d is not None:
+                    h.flush_entry(entry)
+
+            def blame_gated(h, svc):
+                lag = svc.straggler_stats()["current_streak"]
+                if lag:
+                    h.allreduce_async([1.0], name="blamed")
+
+            def blames_gated(h, health):
+                if health.straggler_blames():
+                    h.allreduce_async([1.0], name="blames")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 4, msgs
+        assert "autoscale policy decision state" in msgs
+        assert "dynamic queue/tenant runtime state" in msgs
+
+    def test_autoscale_state_as_value_passes(self, tmp_path):
+        # reading policy/straggler state as a VALUE (logging, sensor
+        # blobs, stats surfaces) is fine; only branching a collective
+        # on it diverges
+        src = """
+            def report(pol, svc, log):
+                log.append(pol.policy_stats())
+                log.append(svc.straggler_stats())
+
+            def stats_near_collective(h, pol):
+                h.allreduce_async([1.0], name="x")
+                snapshot = pol.policy_stats()
+                return snapshot
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
     def test_static_qos_config_passes(self, tmp_path):
         # static weights/priorities/quotas are pure config (identical on
         # every rank by the set_qos contract) — NOT flagged

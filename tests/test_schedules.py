@@ -262,6 +262,40 @@ class TestLoopbackExchange:
 
 
 # ---------------------------------------------------------------------------
+# autoscale decision vs re-form (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class TestAutoscaleDecision:
+    """The autoscale policy's round-tag contract under controlled
+    concurrency: the guarded shape (evaluate tags the round, apply
+    re-validates atomically) explores clean — it is also in the MATRIX
+    sweep — while the planted unguarded eviction must be FOUND evicting
+    the replacement that inherited a re-formed slot, and must replay
+    byte-for-byte from its (seed, trace)."""
+
+    def test_guarded_decision_clean_single_run(self, sched_check):
+        run_model(models.MATRIX["autoscale-decision"], seed=7)
+
+    def test_unguarded_evict_found_and_replays(self, sched_check):
+        # the default schedule is clean: only exploration forces the
+        # re-form into the evaluate->apply window
+        run_model(models.DEMOS["evict-during-reform-demo"], seed=0)
+        result = explore(models.DEMOS["evict-during-reform-demo"],
+                         schedules=60, seed=0)
+        assert not result.ok, "planted stale-round eviction not found"
+        f = result.findings[0]
+        assert f.kind == "model-assertion"
+        assert "stale-round eviction" in str(f)
+        with pytest.raises(SchedFailure) as exc:
+            run_model(models.DEMOS["evict-during-reform-demo"],
+                      seed=f.seed, trace=f.trace)
+        f2 = exc.value
+        assert f2.kind == f.kind
+        assert f2.trace == f.trace
+        assert f2.report == f.report
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 

@@ -56,9 +56,21 @@ _WALLCLOCK_LAST = {"monotonic", "perf_counter"}
 # differ across ranks. A collective submission conditioned on them is
 # the mismatched-collective hang class; static QoS config (weights,
 # priorities, quotas via qos.get_class/set_qos) is NOT in this set.
+# ISSUE 15 adds the autoscale surfaces: `policy_stats()` is
+# driver-authoritative controller state a rank can only observe at some
+# arbitrary point of a membership transition, and `straggler_stats()` /
+# `straggler_blames()` are this-rank observations of peer lag — all
+# three differ across ranks (and across reads) exactly like a queue
+# depth, so branching a collective on them is the same hang class.
 _RUNTIME_STATE_LAST = {"fusion_stats", "qos_stats",
                        "dispatch_cache_stats", "health_stats",
-                       "metrics_dump", "straggler_stats"}
+                       "metrics_dump", "straggler_stats",
+                       "straggler_blames", "policy_stats"}
+# autoscale decision state read as a bare attribute (ISSUE 15,
+# elastic/policy.py): `policy.last_decision` / `policy.decisions` are
+# the controller's mutable decision log — rank-divergent for the same
+# reason as the call surfaces above (caught below like `.is_leader`).
+_POLICY_STATE_ATTRS = {"last_decision", "decisions"}
 # leader-role predicates (ISSUE 13, negotiation/layout.py): "am I a
 # leader" differs per rank exactly like rank() — a collective submission
 # conditioned on it is the same mismatched-collective hang. The static
@@ -100,6 +112,11 @@ def _expr_taint(expr: ast.AST, tainted: dict[str, str]) -> str | None:
             # _taint_call first (ast.walk visits the Call before its
             # func attribute)
             return f"{node.attr} (leader-role state)"
+        if (isinstance(node, ast.Attribute)
+                and node.attr in _POLICY_STATE_ATTRS):
+            return (f"{node.attr} (autoscale policy decision state: "
+                    "decisions are driver-authoritative — a rank must "
+                    "never branch a collective on them)")
         if isinstance(node, ast.Name) and node.id in tainted:
             return tainted[node.id]
     return None

@@ -733,6 +733,116 @@ def elastic_reform():
         raise AssertionError(f"non-monotonic publication: {state}")
 
 
+def autoscale_decision():
+    """Autoscale decision clean matrix (ISSUE 15): a policy decision
+    racing a watchdog peer-failure report (which re-forms the round)
+    and a worker blocked at its commit boundary. Models the guarded
+    shape ``elastic/policy.py`` actually ships: the decision is
+    ROUND-TAGGED at evaluation and the apply re-validates the tag
+    atomically under the round lock — a re-form landing between
+    evaluate and apply degrades the decision to a counted hold, never
+    a membership mutation against the wrong world. Exploration must
+    find no schedule where a stale decision mutates hosts, the blocked
+    commit waiter misses the round notify, or the two resumes publish
+    a duplicate round."""
+    inv = _inv()
+    round_cv = inv.make_condition("autoscale.round_cv")
+    round_lock = inv.make_lock("autoscale.round_lock")
+    state = {"round": 1, "hosts": {"h0", "h1", "h2"},
+             "decisions": [], "published": []}
+
+    def policy():
+        # evaluate: snapshot the round tag (under the lock, like the
+        # driver's rendezvous read), then "think" (a preemption point),
+        # then apply with the tag re-validated atomically
+        with round_lock:
+            tag = state["round"]
+            victim = "h2"
+        with round_lock:
+            if state["round"] != tag or victim not in state["hosts"]:
+                state["decisions"].append(("hold", "stale-round", tag))
+                return
+            state["hosts"].discard(victim)
+            state["hosts"].add("auto0")
+            state["decisions"].append(("evict", "straggler", tag))
+
+    def peer_death_reporter():
+        # watchdog report -> registry failure -> resume publishes the
+        # next round; the dead host's replacement inherits its slot
+        with round_lock:
+            with round_cv:
+                state["round"] += 1
+                state["published"].append(state["round"])
+                state["hosts"] = {"h0", "h1", "h2b"}
+                round_cv.notify_all()
+
+    def commit_waiter():
+        with round_cv:
+            while state["round"] < 2:
+                if not round_cv.wait(30.0):
+                    raise AssertionError(
+                        "commit waiter missed the round notify")
+
+    ts = [inv.spawn_thread(policy, name="policy"),
+          inv.spawn_thread(peer_death_reporter, name="peerfail-report"),
+          inv.spawn_thread(commit_waiter, name="commit-waiter")]
+    for t in ts:
+        inv.join_thread(t)
+    if state["published"] != [2]:
+        raise AssertionError(f"rounds lost/duplicated: {state}")
+    (action, reason, tag) = state["decisions"][0]
+    if action == "evict":
+        # an applied eviction must have run against round 1's world:
+        # h2 replaced by auto0, and the re-form then owns the hosts
+        if tag != 1:
+            raise AssertionError(f"evict applied with a stale tag: {state}")
+    else:
+        # held: the re-form won the race and membership is untouched
+        # by the policy (h2b is the REPORTER's replacement, not ours)
+        if reason != "stale-round" or "auto0" in state["hosts"]:
+            raise AssertionError(f"stale decision mutated hosts: {state}")
+
+
+def evict_during_reform_demo():
+    """PLANTED stale eviction (ISSUE 15): the policy resolves its
+    victim from the decision round's table but applies WITHOUT
+    re-validating the round tag — a schedule where the re-form lands
+    between evaluate and apply evicts the innocent replacement that
+    inherited the dead host's slot (the exact misattribution the
+    round-tag check in ``AutoscalePolicy._apply_evict`` closes, and
+    the driver-side twin of PR 14's stale peer-failure report). Most
+    schedules pass; exploration must FIND the window and the
+    model-assertion finding replays byte-for-byte from (seed, trace)."""
+    inv = _inv()
+    mu = inv.make_lock("evictdemo.mu")
+    state = {"round": 1, "hosts": {"h0", "h1", "h2"}}
+
+    def policy():
+        with mu:
+            tag = state["round"]
+            victim = "h2"  # blamed in round 1
+        # BUG: no round re-validation at apply — a re-form in this
+        # window renames the world and "h2" now labels the replacement
+        with mu:
+            if state["round"] != tag:
+                raise AssertionError(
+                    f"stale-round eviction applied: decision round "
+                    f"{tag}, world already re-formed to round "
+                    f"{state['round']} — evicting the replacement that "
+                    f"inherited the slot")
+            state["hosts"].discard(victim)
+
+    def reformer():
+        with mu:
+            state["round"] += 1
+            state["hosts"] = {"h0", "h1", "h2"}  # replacement, same label
+
+    ts = [inv.spawn_thread(policy, name="policy"),
+          inv.spawn_thread(reformer, name="reformer")]
+    for t in ts:
+        inv.join_thread(t)
+
+
 def stale_plan_after_resize_demo():
     """PLANTED stale-plan-after-resize (ISSUE 14): a dispatch-plan cache
     keyed WITHOUT the process-set shape, read outside the resize lock —
@@ -872,6 +982,7 @@ MATRIX = {
     "pr3-issue-lock": pr3_issue_lock,
     "pr6-chain-guard": pr6_chain_guard,
     "elastic-reform": elastic_reform,
+    "autoscale-decision": autoscale_decision,
 }
 
 DEMOS = {
@@ -883,6 +994,7 @@ DEMOS = {
     "pr3-unguarded": pr3_unguarded,
     "pr6-unguarded": pr6_unguarded,
     "stale-plan-after-resize-demo": stale_plan_after_resize_demo,
+    "evict-during-reform-demo": evict_during_reform_demo,
 }
 
 MODELS = {**MATRIX, **DEMOS}
