@@ -876,6 +876,10 @@ def run_step_bench(args) -> None:
             # drift to whichever mode ran second)
             base_t1, base_params, _ = _run_step_mode(
                 hvd, local_fn, params0, stats0, x, y, 0, args.step_iters)
+            if kind == "transformer":
+                # step-1 params from fixed init: the GSPMD lane's
+                # numerics reference (same model, init, data, optimizer)
+                transformer_step1 = base_params
             bkt_t1, bkt_params, overlap = _run_step_mode(
                 hvd, local_fn, params0, stats0, x, y,
                 args.step_bucket_bytes, args.step_iters)
@@ -922,6 +926,9 @@ def run_step_bench(args) -> None:
                 "buckets": n_buckets,
                 "pipeline_overlap": overlap,
             }
+        # GSPMD execution mode of the same TransformerLM (ISSUE 16):
+        # cached-program fast path vs the retrace-per-call status quo
+        models["gspmd"] = _gspmd_step_lane(hvd, n, args, transformer_step1)
     finally:
         for k, v in prev.items():
             if v is None:
@@ -949,6 +956,114 @@ def run_step_bench(args) -> None:
                    "iters": args.step_iters, "n_chips": n,
                    "backend": jax.devices()[0].platform},
     }))
+
+
+def _gspmd_step_lane(hvd, n, args, eager_step1):
+    """GSPMD execution mode of the step bench's TransformerLM: the whole
+    train step — global-batch loss, backward, ``DistributedOptimizer``
+    update riding the partitioner passthrough — is ONE jit program.
+    Uncached builds a FRESH ``jax.jit`` wrapper per step (the
+    retrace-per-call status quo MULTICHIP_r05 measured at 8.8 s); cached
+    builds a fresh ``hvd.cached_step`` wrapper per step, which replays
+    the recorded executable from the signature cache
+    (ops/gspmd_cache.py). Numerics gate: step-1 params must match the
+    eager-DP transformer lane (same init, data, and optimizer — eager's
+    rank-averaged local-mean gradient IS the GSPMD global-mean
+    gradient), and the cached step-1 params must match the uncached
+    ones."""
+    import optax
+    import jax.numpy as jnp  # noqa: F811 - local for clarity
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+    from horovod_tpu.ops import dispatch_cache, gspmd_cache
+
+    mesh, axis = hvd.mesh(), hvd.axis_name()
+    batch, seq = args.step_batch, args.step_seq_len
+    # keep in sync with the kind == "transformer" eager lane above
+    cfg = TransformerConfig(vocab_size=32768, num_layers=2,
+                            num_heads=8, d_model=256, d_ff=1024,
+                            max_seq_len=seq, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    x_host = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(n * batch, seq))
+    params0 = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, seq), jnp.int32))["params"]
+    x = jax.device_put(x_host, NamedSharding(mesh, P(axis)))
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+
+    def make_step():
+        # re-executed per step: structurally-identical fresh closures,
+        # the per-call retrace pattern the signature cache exists to kill
+        def train_step(params, opt, x):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, x)
+                tgt = jax.nn.one_hot(x[:, 1:], cfg.vocab_size)
+                return -jnp.mean(jnp.sum(
+                    tgt * jax.nn.log_softmax(logits[:, :-1]), -1))
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            updates, new_opt = tx.update(g, opt, params)
+            return optax.apply_updates(params, updates), new_opt, loss
+        return train_step
+
+    params = jax.device_put(params0, NamedSharding(mesh, P()))
+    opt = jax.device_put(tx.init(params0), NamedSharding(mesh, P()))
+    dispatch_cache.reset()
+    gspmd_cache.reset_stats()
+
+    # uncached (status quo): fresh jit wrapper per step — every step pays
+    # trace+lower+compile. 2 steps bound the lane's wall-time cost; the
+    # per-step times are compile-dominated and low-variance.
+    uncached, state, step1 = [], (params, opt), None
+    for i in range(2):
+        t0 = time.perf_counter()
+        p2, o2, loss = jax.jit(make_step())(state[0], state[1], x)
+        jax.block_until_ready(loss)
+        uncached.append((time.perf_counter() - t0) * 1e3)
+        if i == 0:
+            step1 = [np.asarray(l) for l in jax.tree.leaves(p2)]
+        state = (p2, o2)
+
+    # cached: a fresh cached_step wrapper per step — the first records,
+    # every later one must replay with zero retraces
+    cached, state, retraces, cold_ms = [], (params, opt), 0, None
+    cached_step1 = None
+    for i in range(args.step_iters + 1):
+        s = gspmd_cache.cached_step(make_step())
+        t0 = time.perf_counter()
+        p2, o2, loss = s(state[0], state[1], x)
+        jax.block_until_ready(loss)
+        ms = (time.perf_counter() - t0) * 1e3
+        if i == 0:
+            cold_ms = ms
+            cached_step1 = [np.asarray(l) for l in jax.tree.leaves(p2)]
+        else:
+            cached.append(ms)
+            retraces += s.traces
+        state = (p2, o2)
+
+    unc_ms = float(np.median(uncached))
+    warm_ms = float(np.median(cached))
+    hits = dispatch_cache.stats()["hits_by_source"].get("gspmd", 0)
+    # fp reassociation across execution modes: tolerance, not bitwise
+    match_eager = (len(step1) == len(eager_step1) and all(
+        np.allclose(a, b, rtol=1e-4, atol=1e-6)
+        for a, b in zip(step1, eager_step1)))
+    match_cached = all(np.allclose(a, b)
+                       for a, b in zip(step1, cached_step1))
+    return {
+        "uncached_ms_per_step": round(unc_ms, 3),
+        "cached_warm_ms_per_step": round(warm_ms, 3),
+        "cold_record_ms": round(cold_ms, 3),
+        "reduction_pct": round((unc_ms - warm_ms) / unc_ms * 100.0, 1)
+            if unc_ms else 0.0,
+        "warm_retraces": retraces,
+        "cache_hits": hits,
+        "numerics_match": bool(match_eager and match_cached),
+        "cache": gspmd_cache.stats(),
+        "baseline": "fresh jax.jit wrapper per step (retrace-per-call "
+                    "status quo; jit keys on function object identity)",
+    }
 
 
 def _capture_bench_case(hvd, n, args):

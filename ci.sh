@@ -149,9 +149,10 @@ step "1i/6 bucketed step bench (bucketed backward must not be slower than whole-
 # scheduling luck, while a real regression fails every attempt.
 step_bench_gate() {
 python bench.py --step-bench --step-iters 5 --step-batch 1 \
-    --step-bucket-bytes 16777216 | python -c "
-import json, sys
-d = json.loads(sys.stdin.readlines()[-1])
+    --step-bucket-bytes 16777216 > /tmp/hvd_step_bench.out \
+  && python -c "
+import json
+d = json.loads(open('/tmp/hvd_step_bench.out').readlines()[-1])
 assert d['numerics_match'] is True, d
 r = d['models']['resnet50']
 assert r['grad_sync_bucketed_ms'] <= r['grad_sync_whole_ms'] * 1.05, \
@@ -160,12 +161,27 @@ assert r['bucketed_ms_per_step'] <= r['whole_tree_ms_per_step'] * 1.10, \
     'bucketed backward slower than whole-tree beyond CI noise: %r' % r
 assert r['pipeline_overlap']['overlap_ratio'] > 0.0, \
     'bucketed backward shows zero comm overlap: %r' % r
+# ISSUE-16 GSPMD lane: cached replay at least halves the
+# retrace-per-call step, with zero retraces, hits attributed to the
+# gspmd source, and numerics matching both the uncached GSPMD step and
+# the eager-DP lane
+g = d['models']['gspmd']
+assert g['numerics_match'] is True, g
+assert g['warm_retraces'] == 0, \
+    'gspmd cached replay retraced: %r' % g
+assert g['cache_hits'] >= 1, \
+    'gspmd lane registered no dispatch-cache hits: %r' % g
+assert g['reduction_pct'] >= 50.0, \
+    'gspmd cached replay under 50%% step-time reduction: %r' % g
 print('step bench OK: resnet50 step %.0f -> %.0f ms (%.1f%%), grad sync '
       '%.0f -> %.0f ms (%.1f%%), overlap_ratio %.2f, %d buckets' % (
           r['whole_tree_ms_per_step'], r['bucketed_ms_per_step'],
           r['reduction_pct'], r['grad_sync_whole_ms'],
           r['grad_sync_bucketed_ms'], r['grad_sync_reduction_pct'],
-          r['pipeline_overlap']['overlap_ratio'], r['buckets']))"
+          r['pipeline_overlap']['overlap_ratio'], r['buckets']))
+print('gspmd lane OK: %.0f -> %.0f ms warm (%.1f%%), %d cache hits' % (
+    g['uncached_ms_per_step'], g['cached_warm_ms_per_step'],
+    g['reduction_pct'], g['cache_hits']))"
 }
 step_bench_gate || {
   echo "step bench attempt 1 failed; retrying in a fresh process"
@@ -174,6 +190,9 @@ step_bench_gate || {
     step_bench_gate
   }
 }
+# both execution modes (eager-DP bucketing + GSPMD cached program) on one
+# perf trajectory; the passing run's artifact is BENCH_r16.json
+tail -1 /tmp/hvd_step_bench.out > BENCH_r16.json
 
 step "1m/6 metrics scrape gate (loopback world=4 /metrics completeness; docs/metrics.md)"
 # ISSUE-11 acceptance: a curl-able /metrics on the loopback world's KV

@@ -77,9 +77,11 @@ from .ops import (
     broadcast,
     broadcast_async,
     broadcast_object,
+    cached_step,
     dispatch_cache_stats,
     fusion_flush,
     fusion_stats,
+    gspmd_cache_stats,
     grouped_allreduce,
     grouped_allreduce_async,
     grouped_broadcast,
@@ -166,7 +168,8 @@ __all__ = [
     "allgather_async", "allgather_object", "allreduce", "allreduce_",
     "allreduce_async", "alltoall", "alltoall_async", "barrier", "broadcast",
     "broadcast_", "broadcast_async", "broadcast_object",
-    "dispatch_cache_stats", "fusion_flush", "fusion_stats", "step_marker",
+    "cached_step", "dispatch_cache_stats", "fusion_flush", "fusion_stats",
+    "gspmd_cache_stats", "step_marker",
     "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
     "grouped_broadcast_async",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",

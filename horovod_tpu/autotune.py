@@ -148,6 +148,12 @@ def _default_tunables() -> list[Tunable]:
         # epoch, which drops cached step plans — a stale capture can
         # never survive a knob change.
         Tunable(envs.STEP_CAPTURE, [0, 1]),
+        # GSPMD cached-program fast path (ops/gspmd_cache.py). Default-on
+        # first so enabling autotune changes nothing at sample 0; 0
+        # restores plain per-call jit for A/B measurement. Flipping the
+        # override bumps the envs epoch, which drops cached step
+        # executables — a stale program can never survive the change.
+        Tunable(envs.GSPMD_CACHE, [1, 0]),
         # Multi-tenant QoS pacing (qos.py; consumed live per gate pump,
         # inert with HVD_QOS=0). Defaults first so enabling autotune
         # changes nothing at sample 0. Safe to tune: quantum/window only

@@ -460,11 +460,27 @@ STEP_CAPTURE_STEPS = counter(
     "fallback / invalidated / uncapturable).",
     labels=("event",))
 
+# -- GSPMD cached-program fast path (ops/gspmd_cache.py) -------------------
+GSPMD_CACHE_PHASE = gauge(
+    "hvd_gspmd_cache_phase",
+    "GSPMD cached-step lifecycle phase (the step-capture vocabulary): "
+    "0 idle, 1 record (building), 3 replayed, 4 bypass.")
+GSPMD_CACHE_STEPS = counter(
+    "hvd_gspmd_cache_steps_total",
+    "GSPMD cached-step lifecycle events by kind (recorded / replayed / "
+    "fallback / invalidated / bypass).",
+    labels=("event",))
+GSPMD_PASSTHROUGH_SYNCS = counter(
+    "hvd_gspmd_passthrough_syncs_total",
+    "Gradient syncs traced through DistributedOptimizer's GSPMD "
+    "passthrough branch (once per TRACE, not per step — frozen while "
+    "cached steps replay).")
+
 # -- dispatch plan cache (ops/dispatch_cache.py; backs
 #    hvd.dispatch_cache_stats() -- always on) ------------------------------
 DISPATCH_HITS = counter(
     "hvd_dispatch_plan_hits_total",
-    "Dispatch-plan cache hits by source (call / flush / step).",
+    "Dispatch-plan cache hits by source (call / flush / step / gspmd).",
     labels=("source",), always=True)
 DISPATCH_MISSES = counter(
     "hvd_dispatch_plan_misses_total",
@@ -487,6 +503,10 @@ DISPATCH_CHUNKED_BUILDS = counter(
 DISPATCH_STEP_BUILDS = counter(
     "hvd_dispatch_step_builds_total",
     "Whole-step capture plans built (ops/step_capture.py).", always=True)
+DISPATCH_GSPMD_BUILDS = counter(
+    "hvd_dispatch_gspmd_builds_total",
+    "Compiled GSPMD step programs built (ops/gspmd_cache.py: one "
+    "lower+compile per new step signature).", always=True)
 
 # -- retry ladder (utils/retry.py; backs hvd.health_stats()["retries"]
 #    -- always on) ---------------------------------------------------------

@@ -29,6 +29,8 @@ from .dispatch_cache import stats as dispatch_cache_stats
 from .fusion_cycle import fusion_flush
 from .fusion_cycle import reset as reset_fusion_cycle
 from .fusion_cycle import stats as fusion_stats
+from .gspmd_cache import cached_step
+from .gspmd_cache import stats as gspmd_cache_stats
 from .step_capture import step_marker
 from .adasum import adasum_allreduce
 from .hierarchical import (
@@ -54,6 +56,7 @@ __all__ = [
     "grouped_broadcast", "grouped_broadcast_async", "join", "per_rank",
     "poll", "reducescatter", "synchronize", "adasum_allreduce",
     "dispatch_cache_stats", "reset_dispatch_cache",
+    "cached_step", "gspmd_cache_stats",
     "fusion_flush", "fusion_stats", "reset_fusion_cycle", "step_marker",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",

@@ -685,6 +685,12 @@ def _gspmd_passthrough_check(op: ReduceOp, name: str) -> None:
     hvd_logging.debug(
         "%s inside jit/pjit without a bound axis: GSPMD passthrough "
         "(gradients are already globally reduced by the partitioner)", name)
+    # Trace-time tally: every sync the partitioner absorbed is a sync the
+    # cached-program fast path (ops/gspmd_cache.py) never pays again on
+    # replay. Function-level import — gspmd_cache imports this module's
+    # siblings.
+    from . import gspmd_cache
+    gspmd_cache.note_passthrough()
 
 
 def _check_op_dtype(op: ReduceOp, dtype):

@@ -147,6 +147,12 @@ def _current_shape() -> tuple | None:
 def _restorable(key: tuple, plan) -> bool:
     if plan is UNPLANNABLE:
         return False
+    if getattr(plan, "variant", None) == "gspmd":
+        # A compiled GSPMD step bakes the old world's device assignment
+        # into the executable; a re-formed world (even at the same
+        # shape) may map ranks to different devices, so these never
+        # ride the warm shelf — the first warm call re-lowers.
+        return False
     if getattr(plan, "variant", None) == "step":
         return bool(getattr(plan, "rebindable", False))
     # Eager plan keys carry the pset dispatch_key at index 4: "g" (an
@@ -271,12 +277,14 @@ def _ctx_store():
 # one rank's counters never bleed into a peer's view.
 #
 # Where a plan hit was served from: "call" (direct eager collective),
-# "flush" (a fusion-cycle flush coalescing a queue), or "step" (the
-# step capture-and-replay program, ops/step_capture.py). Per-source hit
+# "flush" (a fusion-cycle flush coalescing a queue), "step" (the step
+# capture-and-replay program, ops/step_capture.py), or "gspmd" (a
+# replayed compiled jit/pjit step, ops/gspmd_cache.py). Per-source hit
 # counters keep the overlap/coalesce ratios honest when capture is on —
 # a replayed step serves ONE step-plan hit where the per-flush path
-# would have served one hit per flush.
-_SOURCES = ("call", "flush", "step")
+# would have served one hit per flush — and put both execution modes'
+# cached-program hits on one accounting surface.
+_SOURCES = ("call", "flush", "step", "gspmd")
 _tls = threading.local()
 
 
@@ -389,6 +397,41 @@ def note_step_hit() -> None:
     _timeline.record_dispatch("step", hit=True)
 
 
+def note_gspmd_hit() -> None:
+    """Count one SERVED compiled GSPMD step replay
+    (``hits_by_source["gspmd"]``) — the gspmd twin of
+    :func:`note_step_hit`: counted after the executable accepts its
+    inputs, so a signature hit whose executable rejects (the divergence
+    fallback) never counts."""
+    _metrics.DISPATCH_HITS.inc(labels={"source": "gspmd"})
+    _timeline.record_dispatch("gspmd", hit=True)
+
+
+def fold_knobs(variant: str, key: tuple, *raw_knob_values) -> tuple:
+    """THE store-key canonicalizer shared by the whole-step program
+    caches (``step_capture._store_key`` / ``gspmd_cache``): prefix a
+    content ``key`` with its plan ``variant`` and the RAW values of
+    every knob the compiled program bakes in. Override-driven knob
+    changes already invalidate via the cache epoch, but a raw
+    ``os.environ`` change does not bump the epoch — folding the values
+    into the key means a stale program can never replay."""
+    return (variant,) + tuple(raw_knob_values) + (key,)
+
+
+def drop(key: tuple) -> bool:
+    """Remove ONE plan from this thread's store (the gspmd divergence
+    contract: an executable that rejected its inputs despite a
+    signature hit must not serve again). Returns whether a plan was
+    present. Unlike :func:`invalidate`, every other plan survives."""
+    ctx = _ctx_store()
+    plans = ctx.plans if ctx is not None else _plans
+    with _lock:
+        found = plans.pop(key, None)
+        if found is not None:
+            _metrics.DISPATCH_INVALIDATIONS.inc()
+    return found is not None
+
+
 def store(key: tuple, plan: DispatchPlan) -> None:
     """Insert ``plan`` (LRU-evicting past capacity). No-op when caching is
     disabled, so the build-per-call path stays allocation-clean."""
@@ -404,6 +447,8 @@ def store(key: tuple, plan: DispatchPlan) -> None:
             _metrics.DISPATCH_CHUNKED_BUILDS.inc()
         if plan is not UNPLANNABLE and plan.variant == "step":
             _metrics.DISPATCH_STEP_BUILDS.inc()
+        if plan is not UNPLANNABLE and plan.variant == "gspmd":
+            _metrics.DISPATCH_GSPMD_BUILDS.inc()
         _sync_epoch_locked(ctx, plans, epoch)
         # Elastic warm re-form: adopt the shelved incarnation's compiled
         # execute stage before the first call pays the retrace/recompile.
@@ -475,6 +520,7 @@ def stats() -> dict:
             _metrics.DISPATCH_NEGOTIATION_SKIPS.value()),
         "chunked_builds": int(_metrics.DISPATCH_CHUNKED_BUILDS.value()),
         "step_builds": int(_metrics.DISPATCH_STEP_BUILDS.value()),
+        "gspmd_builds": int(_metrics.DISPATCH_GSPMD_BUILDS.value()),
         # elastic warm re-form (docs/elastic.md): plans waiting in this
         # world's warm pool, and compiled stages grafted from it
         "warm_pool": warm_pool,
@@ -488,7 +534,8 @@ def reset_stats() -> None:
                  _metrics.DISPATCH_EVICTIONS,
                  _metrics.DISPATCH_NEGOTIATION_SKIPS,
                  _metrics.DISPATCH_CHUNKED_BUILDS,
-                 _metrics.DISPATCH_STEP_BUILDS):
+                 _metrics.DISPATCH_STEP_BUILDS,
+                 _metrics.DISPATCH_GSPMD_BUILDS):
         inst.reset()
 
 

@@ -397,30 +397,47 @@ def _store_key(key: tuple) -> tuple:
     """Dispatch-cache key for a sealed capture: the stream's content
     signature PLUS the raw knob values the compiled programs bake in
     (fusion threshold -> bucket metas; pipeline threshold/chunks ->
-    in-program chunk layout). Override-driven knob changes already
-    invalidate via the cache epoch, but a raw os.environ change does
-    not bump the epoch — folding the values into the key means a
-    stale layout can never replay (the eager plan keys do the same)."""
+    in-program chunk layout), canonicalized through the shared
+    :func:`~.dispatch_cache.fold_knobs` discipline the GSPMD program
+    cache (``ops/gspmd_cache.py``) also uses. Override-driven knob
+    changes already invalidate via the cache epoch, but a raw
+    os.environ change does not bump the epoch — folding the values into
+    the key means a stale layout can never replay (the eager plan keys
+    do the same)."""
     from . import collectives as _coll
-    return ("step", envs.fusion_threshold_bytes(), _coll._pipeline_key(),
-            key)
+    return _dispatch.fold_knobs("step", key, envs.fusion_threshold_bytes(),
+                                _coll._pipeline_key())
 
 
 # Registry mirror of the capture lifecycle (docs/metrics.md): a numeric
-# phase gauge plus per-event counters. The per-instance `_stats` dict
-# stays the `fusion_stats()["capture"]` storage (tests build standalone
+# phase gauge plus per-event counters, with ONE phase vocabulary shared
+# across the cached-program layers — ``ops/gspmd_cache.py`` mirrors its
+# lifecycle through `_lifecycle_note` onto its own instruments with
+# these same codes. The per-instance `_stats` dict stays the
+# `fusion_stats()["capture"]` storage (tests build standalone
 # schedulers whose capture counters must not mix); the registry mirror
 # is the scrapeable view.
 _PHASE_CODES = {"idle": 0, "record": 1, "replay": 2, "replayed": 3,
                 "bypass": 4}
 
 
+def _lifecycle_note(steps_counter, phase_gauge,
+                    event: str | None = None,
+                    state: str | None = None) -> None:
+    """Shared lifecycle mirror of the cached-program architecture: one
+    event counter bump and/or one phase-gauge transition (capture and
+    gspmd plans use the same event names and phase codes, so the two
+    execution modes read identically on the metrics surface)."""
+    if event is not None:
+        steps_counter.inc(labels={"event": event})
+    if state is not None:
+        phase_gauge.set(_PHASE_CODES.get(state, 0))
+
+
 def _note_capture(event: str | None = None,
                   state: str | None = None) -> None:
-    if event is not None:
-        _metrics.STEP_CAPTURE_STEPS.inc(labels={"event": event})
-    if state is not None:
-        _metrics.STEP_CAPTURE_PHASE.set(_PHASE_CODES.get(state, 0))
+    _lifecycle_note(_metrics.STEP_CAPTURE_STEPS,
+                    _metrics.STEP_CAPTURE_PHASE, event, state)
 
 
 class CaptureState:

@@ -68,6 +68,8 @@ SPARSE_AS_DENSE = "SPARSE_AS_DENSE"  # force sparse grads onto dense allreduce
 BUCKET_BYTES = "BUCKET_BYTES"  # gradient bucket size for backward-pass overlap (0 = whole-tree)
 EAGER_CHAIN = "EAGER_CHAIN"  # auto|1|0: let eager consumer math chain on in-flight collective results
 STEP_CAPTURE = "STEP_CAPTURE"  # capture-and-replay of the per-step collective stream (0 = off)
+GSPMD_CACHE = "GSPMD_CACHE"  # cached-program fast path for jit/pjit train steps (0 = plain jit per call)
+GSPMD_CACHE_DONATE = "GSPMD_CACHE_DONATE"  # auto|1|0: donate param/opt-state buffers into cached GSPMD steps
 FLASH_ATTENTION = "FLASH_ATTENTION"  # opt into the Pallas flash kernel
 DEBUG_INVARIANTS = "DEBUG_INVARIANTS"  # dev-mode runtime invariant checker
 SCHED_CHECK = "SCHED_CHECK"  # cooperative schedule-exploration checker (tools/hvdsched)
@@ -341,6 +343,31 @@ def step_capture_enabled() -> bool:
     stays off (the transparent eager path, like any divergence;
     docs/qos.md)."""
     return get_bool(STEP_CAPTURE, False) and not qos_enabled()
+
+
+def gspmd_cache_enabled() -> bool:
+    """GSPMD cached-program fast path (``ops/gspmd_cache.py``): store
+    lowered+compiled jit/pjit step executables in the dispatch plan
+    cache under a stable step signature, so re-created step closures
+    replay instead of retracing. Default on — ``hvd.cached_step`` is an
+    explicit opt-in API, so the knob is a kill switch; it also rides
+    the cache-wide ``HVD_CACHE_CAPACITY=0`` off switch (cached steps
+    are dispatch plans like any other)."""
+    return get_bool(GSPMD_CACHE, True) and cache_capacity() > 0
+
+
+def gspmd_donate_enabled(platform: str) -> bool:
+    """Whether cached GSPMD steps donate their parameter/optimizer
+    buffers (``donate_argnums`` derived from the step's pytree layout).
+    'auto' follows :func:`donation_effective`: on backends where
+    donation is a memory no-op the derivation (an extra abstract trace)
+    buys nothing."""
+    val = (get(GSPMD_CACHE_DONATE, "auto") or "auto").strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    return donation_effective(platform)
 
 
 def pipeline_chunking_enabled() -> bool:

@@ -1,0 +1,272 @@
+"""GSPMD cached-program fast path (ISSUE 16 tentpole): a stable
+step-signature cache serves lowered+compiled jit/pjit train steps out of
+the dispatch plan cache — hit across re-created closures and
+structurally-identical pytrees, miss (and coexist) on sharding drift,
+flush on knob-override epoch, donate the params/opt-state carry under
+the alias-guard rules, and fall back to a plain traced call (no hang,
+no stale program) when a cached executable rejects its inputs.
+Numerics must be identical cache on/off and donation on/off."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from backend_markers import loopback_world  # noqa: F401 - fixture
+from horovod_tpu.ops import dispatch_cache, gspmd_cache
+from horovod_tpu.utils import envs
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _gspmd_env():
+    dispatch_cache.reset()
+    gspmd_cache.reset_stats()
+    yield
+    dispatch_cache.reset()
+    gspmd_cache.reset_stats()
+
+
+def _make_step():
+    # re-executed per wrapper: structurally-identical fresh closures —
+    # one code constant, so the content fingerprint must match
+    def train_step(params, x):
+        return jax.tree.map(lambda p: p - 0.1 * x.mean(), params)
+    return train_step
+
+
+def _params(scale=1.0):
+    return {"w": jnp.full((4, 4), scale), "b": jnp.zeros((4,))}
+
+
+def _gspmd_hits():
+    return dispatch_cache.stats()["hits_by_source"].get("gspmd", 0)
+
+
+# ---------------------------------------------------------------- hit/miss
+
+def test_recreated_closure_replays_without_retrace(hvd):
+    x = jnp.arange(8.0)
+    s1 = gspmd_cache.cached_step(_make_step())
+    out1 = s1(_params(), x)
+    assert s1.traces == 1
+    assert dispatch_cache.stats()["gspmd_builds"] == 1
+
+    # a FRESH wrapper over a freshly-built closure — the jit-identity
+    # retrace pattern — must serve the recorded executable
+    s2 = gspmd_cache.cached_step(_make_step())
+    out2 = s2(_params(), x)
+    assert s2.traces == 0
+    assert dispatch_cache.stats()["gspmd_builds"] == 1
+    assert _gspmd_hits() == 1
+    for k in out1:
+        np.testing.assert_allclose(np.asarray(out2[k]), np.asarray(out1[k]))
+
+
+def test_structurally_identical_pytrees_share_one_program(hvd):
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(_make_step())
+    step(_params(1.0), x)
+    # different leaf OBJECTS and values, same structure/avals: a hit
+    step(_params(3.0), x)
+    assert step.traces == 1
+    assert _gspmd_hits() == 1
+
+
+def test_shape_drift_is_a_miss_and_signatures_coexist(hvd):
+    step = gspmd_cache.cached_step(_make_step())
+    step(_params(), jnp.arange(8.0))
+    step(_params(), jnp.arange(4.0))  # drift: new signature, new program
+    assert step.traces == 2
+    assert dispatch_cache.stats()["gspmd_builds"] == 2
+    # both signatures now replay — train/eval shapes coexist
+    step(_params(), jnp.arange(8.0))
+    step(_params(), jnp.arange(4.0))
+    assert step.traces == 2
+    assert _gspmd_hits() == 2
+
+
+def test_sharding_drift_is_a_miss(hvd):
+    devs = jax.devices()[:N]
+    mesh = Mesh(np.array(devs).reshape(N), ("dp",))
+    x = jnp.arange(8.0)
+    wide = {"w": jnp.ones((N, 4)), "b": jnp.zeros((N,))}
+    p_repl = jax.device_put(wide, NamedSharding(mesh, P()))
+    p_row = {
+        "w": jax.device_put(jnp.ones((N, 4)), NamedSharding(mesh, P())),
+        "b": jax.device_put(jnp.zeros((N,)), NamedSharding(mesh, P("dp"))),
+    }
+    step = gspmd_cache.cached_step(_make_step())
+    step(p_repl, x)
+    # same avals, different placement: must not replay (a program
+    # compiled for the replicated layout would silently mis-place the
+    # row-sharded buffers). jax's own trace cache keys on avals so no
+    # NEW trace happens — the miss shows up as a second build.
+    step(p_row, x)
+    assert dispatch_cache.stats()["gspmd_builds"] == 2
+    # and both placements now replay from their own programs
+    step(p_repl, x)
+    step(p_row, x)
+    assert _gspmd_hits() == 2
+
+
+def test_output_shardings_round_trip_into_next_step(hvd):
+    # trailing-None PartitionSpec canonicalization: feeding step N's
+    # outputs into step N+1 must hit, not re-record
+    devs = jax.devices()[:N]
+    mesh = Mesh(np.array(devs).reshape(N), ("dp",))
+    p = {"w": jax.device_put(jnp.ones((N, 4)),
+                             NamedSharding(mesh, P("dp", None)))}
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(_make_step())
+    p = step(p, x)
+    p = step(p, x)
+    assert step.traces == 1
+    assert _gspmd_hits() == 1
+
+
+# ------------------------------------------------------------ invalidation
+
+def test_knob_epoch_flushes_cached_programs(hvd):
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(_make_step())
+    step(_params(), x)
+    assert dispatch_cache.stats()["gspmd_builds"] == 1
+    envs.set_override(envs.FUSION_THRESHOLD, 123456)
+    try:
+        # the override bumped the cache epoch: every plan (gspmd
+        # included) is gone, so the same signature re-records (jax's
+        # own lowering cache makes the rebuild cheap — no new trace —
+        # but the cache must not serve the pre-override program)
+        step(_params(), x)
+        assert dispatch_cache.stats()["gspmd_builds"] == 2
+        assert gspmd_cache.stats()["events"].get("recorded", 0) == 2
+        assert _gspmd_hits() == 0
+    finally:
+        envs.clear_override(envs.FUSION_THRESHOLD)
+
+
+def test_disabled_knob_bypasses_cache(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_GSPMD_CACHE", "0")
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(_make_step())
+    out = step(_params(), x)
+    out2 = step(_params(), x)
+    assert dispatch_cache.stats()["gspmd_builds"] == 0
+    assert _gspmd_hits() == 0
+    assert gspmd_cache.stats()["events"].get("bypass", 0) == 2
+    for k in out:
+        np.testing.assert_allclose(np.asarray(out2[k]), np.asarray(out[k]))
+
+
+# ---------------------------------------------------------------- donation
+
+def test_donation_numerics_parity_three_step_lockstep(hvd, monkeypatch):
+    # force donation on (auto resolves off on CPU); CPU enforces the
+    # alias check and input deletion even though memory is not recycled
+    monkeypatch.setenv("HVD_GSPMD_CACHE_DONATE", "1")
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(_make_step())
+    plain = jax.jit(_make_step())
+
+    donated, reference = _params(), _params()
+    for i in range(3):
+        prev = donated
+        donated = step(donated, x)
+        reference = plain(reference, x)
+        for k in reference:
+            np.testing.assert_allclose(np.asarray(donated[k]),
+                                       np.asarray(reference[k]),
+                                       err_msg=f"step {i} leaf {k}")
+    # the carry really was donated: the previous step's buffers are gone
+    with pytest.raises(RuntimeError, match="[Dd]eleted"):
+        np.asarray(prev["w"])
+    # and the batch input (aval absent from the outputs) was NOT donated
+    assert np.asarray(x).shape == (8,)
+
+
+def test_donation_alias_guard_excludes_shared_buffers(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_GSPMD_CACHE_DONATE", "1")
+
+    def make_two_arg():
+        def train_step(a, b, x):
+            return (jax.tree.map(lambda p: p - x.mean(), a),
+                    jax.tree.map(lambda p: p + x.mean(), b))
+        return train_step
+
+    shared = _params()
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(make_two_arg())
+    # the SAME tree object in two donated-eligible positions: the alias
+    # guard must exclude both, so the call neither errors nor deletes
+    out_a, out_b = step(shared, shared, x)
+    np.testing.assert_allclose(np.asarray(shared["w"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out_a["w"]),
+                               np.asarray(shared["w"]) - x.mean())
+    np.testing.assert_allclose(np.asarray(out_b["w"]),
+                               np.asarray(shared["w"]) + x.mean())
+
+
+# ---------------------------------------------------------------- fallback
+
+def test_rejecting_executable_falls_back_and_rerecords(hvd):
+    x = jnp.arange(8.0)
+    step = gspmd_cache.cached_step(_make_step())
+    ref = step(_params(), x)
+    key = step._store_key((_params(), x))
+    plan = dispatch_cache.lookup(key, record_stats=False)
+    assert plan is not None
+
+    def rejecting_execute(*args):
+        raise TypeError("Argument types differ from the types for which "
+                        "this computation was compiled (forced)")
+
+    plan.execute = rejecting_execute
+    # signature hit, executable rejection: the call must complete with
+    # correct numerics (plain traced fallback), drop the stale plan, and
+    # never count a hit
+    out = step(_params(), x)
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]))
+    assert _gspmd_hits() == 0
+    assert dispatch_cache.lookup(key, record_stats=False) is None
+    assert gspmd_cache.stats()["events"].get("invalidated", 0) == 1
+    # the next call re-records a fresh program and replays again
+    step(_params(), x)
+    step(_params(), x)
+    assert dispatch_cache.stats()["gspmd_builds"] == 2
+    assert _gspmd_hits() == 1
+
+
+# ---------------------------------------------------------------- loopback
+
+def test_loopback_world4_per_rank_isolation():
+    import horovod_tpu as hvd
+
+    with hvd.loopback.world(4) as w:
+        def body():
+            r = hvd.rank()
+            dispatch_cache.reset()
+            gspmd_cache.reset_stats()
+            step = gspmd_cache.cached_step(_make_step())
+            out1 = step({"w": jnp.full((4,), float(r))}, jnp.arange(4.0))
+            out2 = step({"w": jnp.full((4,), float(r))}, jnp.arange(4.0))
+            return (float(np.asarray(out1["w"])[0]),
+                    float(np.asarray(out2["w"])[0]),
+                    dispatch_cache.stats()["gspmd_builds"],
+                    dispatch_cache.stats()["hits_by_source"].get(
+                        "gspmd", 0))
+
+        outcomes = w.run(body)
+    for rank, o in enumerate(outcomes):
+        v1, v2, builds, hits = o.result
+        # rank-distinct inputs, rank-local caches: each rank records its
+        # OWN program once and replays it once — no cross-rank bleed
+        expect = rank - 0.1 * np.arange(4.0).mean()
+        assert abs(v1 - expect) < 1e-6, (rank, v1)
+        assert v1 == v2
+        assert builds == 1, (rank, builds)
+        assert hits == 1, (rank, hits)

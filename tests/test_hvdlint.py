@@ -467,6 +467,41 @@ class TestDonation:
         found = findings_for(tmp_path, "donation", {"ok.py": src})
         assert found == []
 
+    def test_trips_on_gspmd_cached_step_read_after_donate(self, tmp_path):
+        # the ISSUE-16 seam: params/opt-state handed to a donated
+        # cached-step position belong to the step — a dynamic donate=
+        # mask conservatively donates every position, so reading params
+        # after the call is the read-after-donate class
+        src = """
+            def train(fn, params, batch, mask):
+                step = _gspmd_step_program(fn, (params, batch),
+                                           donate=mask)
+                out = step(params, batch)
+                return params, out  # params was donated into step
+        """
+        found = findings_for(tmp_path, "donation", {"bad.py": src})
+        assert len(found) == 1
+        assert "'params' was donated" in found[0].message
+
+    def test_passes_on_gspmd_cached_step_rebinding(self, tmp_path):
+        # the training-loop idiom: the donated carry is rebound from the
+        # step's outputs, so later reads see fresh buffers; donate=()
+        # never donates at all
+        src = """
+            def train(fn, params, batch, mask):
+                step = _gspmd_step_program(fn, (params, batch),
+                                           donate=mask)
+                params = step(params, batch)
+                return params
+
+            def undonated(fn, params, batch):
+                step = _gspmd_step_program(fn, (params, batch), donate=())
+                out = step(params, batch)
+                return params, out
+        """
+        found = findings_for(tmp_path, "donation", {"ok.py": src})
+        assert found == []
+
 
 # ---------------------------------------------------------------------------
 # issue-lock x step capture (the un-serialized-jit-in-step_capture class)
@@ -503,6 +538,39 @@ class TestStepCaptureIssueLock:
         """
         found = findings_for(tmp_path, "issue-lock",
                              {"step_capture.py": src})
+        assert found == []
+
+
+class TestGspmdCacheIssueLock:
+    def test_trips_on_unserialized_aot_compile(self, tmp_path):
+        # an AOT-compiled GSPMD step enqueued without the program-issue
+        # lock is the same concurrent-enqueue deadlock class — the
+        # .lower().compile() chain does not exempt the jit call
+        src = """
+            import jax
+
+            def _gspmd_step_program(fn, args, donate=()):
+                return jax.jit(
+                    fn, donate_argnums=tuple(donate)).lower(*args).compile()
+        """
+        found = findings_for(tmp_path, "issue-lock",
+                             {"gspmd_cache.py": src})
+        assert len(found) == 1
+        assert "issue_serialized" in found[0].message
+
+    def test_passes_on_serialized_aot_compile(self, tmp_path):
+        # the in-tree gspmd_cache idiom: the whole lower/compile chain
+        # nests inside the _issue_serialized argument expression
+        src = """
+            import jax
+            from .program_issue import issue_serialized as _issue_serialized
+
+            def _gspmd_step_program(fn, args, donate=()):
+                return _issue_serialized(jax.jit(
+                    fn, donate_argnums=tuple(donate)).lower(*args).compile())
+        """
+        found = findings_for(tmp_path, "issue-lock",
+                             {"gspmd_cache.py": src})
         assert found == []
 
 

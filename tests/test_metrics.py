@@ -181,6 +181,8 @@ class TestLegacyViews:
                           "hits_by_source", "misses", "invalidations",
                           "evictions", "negotiation_skips",
                           "chunked_builds", "step_builds",
+                          # ISSUE 16: GSPMD cached-program executables
+                          "gspmd_builds",
                           # ISSUE 14: elastic warm re-form pool/grafts
                           "warm_pool", "warm_reuses"}
         assert set(s["hits_by_source"]) >= {"call", "flush", "step"}

@@ -25,7 +25,10 @@ What counts as a donating callable:
   ping-pong; the per-piece programs donate arg 0), and the
   ``donate=``-parameterized cached constructors
   (``_eager_grouped_allreduce_fn`` / ``_eager_grouped_broadcast_fn`` /
-  ``_eager_hier_grouped_allreduce_fn`` / ``_piece_allreduce_fn``).
+  ``_eager_hier_grouped_allreduce_fn`` / ``_piece_allreduce_fn``, plus
+  the GSPMD cached-step compiler ``_gspmd_step_program`` — params and
+  opt-state handed to a donated cached-step position belong to the
+  step).
 
 Bindings flow into nested functions (the plan ``execute`` closures are
 where the calls actually happen). The analysis is line-ordered (control
@@ -61,6 +64,10 @@ CONSTRUCTORS = {
     "_eager_grouped_broadcast_fn": "donate-kwarg",
     "_eager_hier_grouped_allreduce_fn": "donate-kwarg",
     "_piece_allreduce_fn": "donate-kwarg",
+    # GSPMD cached-step compiler (ops/gspmd_cache.py): the result is the
+    # compiled step executable; its donate= positions are the derived
+    # params/opt-state mask (dynamic at every call site -> ALL)
+    "_gspmd_step_program": "donate-kwarg",
 }
 
 
@@ -106,6 +113,11 @@ def _donate_kwarg_positions(call: ast.Call):
             if val.value in (False, None, ()):
                 return None
             return frozenset({0})  # donate=True: single-buffer programs
+        if isinstance(val, ast.Tuple) and not val.elts:
+            return None  # donate=() — explicit no-donation
+        if isinstance(val, ast.Tuple) and all(
+                isinstance(e, ast.Constant) for e in val.elts):
+            return frozenset(e.value for e in val.elts)
         return ALL
     return None
 
