@@ -138,6 +138,13 @@ from . import data
 from . import elastic
 from . import loopback
 from . import parallel
+from .parallel.mesh import (
+    MeshLayout,
+    MeshLayoutError,
+    composed_mesh,
+    mesh_layout,
+    sync_gradients,
+)
 from .callbacks import average_metrics, metric_average
 from .version import __version__
 
@@ -173,6 +180,8 @@ __all__ = [
     "grouped_allreduce", "grouped_allreduce_async", "grouped_broadcast",
     "grouped_broadcast_async",
     "hierarchical_allgather", "hierarchical_allreduce", "hierarchical_mesh",
+    "MeshLayout", "MeshLayoutError", "composed_mesh", "mesh_layout",
+    "sync_gradients",
     "join", "per_rank", "poll", "reducescatter", "synchronize",
     "SparseRows", "rows_from_dense", "rows_to_dense", "sparse_allreduce", "sparse_allreduce_async",
     "sparse_allreduce_to_dense",

@@ -186,12 +186,14 @@ class DynamicService:
         self.cycle_time_s = cycle_time_s
         # Coordinator ResponseCache (docs/negotiation.md): steady-state
         # batches whose responses are confirmed globally coherent are
-        # answered locally with zero KV rounds. Off by default
-        # (HVD_RESPONSE_CACHE); invalidated on knob-override epoch,
-        # coordinated abort, and service stop/reset (which is how
-        # process-set changes and elastic re-forms reach it — a new
-        # world builds new services).
-        cap = envs.response_cache_capacity()
+        # answered locally with zero KV rounds. AUTO-on whenever the
+        # hierarchical control plane is active for this world
+        # (HVD_RESPONSE_CACHE=0 is a hard off); invalidated on
+        # knob-override epoch (which also flips it on/off/resized live —
+        # see _rc_refresh_epoch), coordinated abort, and service
+        # stop/reset (which is how process-set changes and elastic
+        # re-forms reach it — a new world builds new services).
+        cap = envs.response_cache_capacity(world)
         self._rcache = (_rcache.ResponseCache(cap, pset_key)
                         if cap > 0 else None)
         self._rc_epoch = envs.override_epoch()
@@ -556,6 +558,32 @@ class DynamicService:
 
     # -- internals ---------------------------------------------------------
 
+    def _rc_refresh_epoch(self) -> None:
+        """Apply a mid-job ``HVD_RESPONSE_CACHE`` flip on the
+        knob-override epoch boundary (the flip-the-cache-mid-job
+        ergonomics of the default-on rollout): an override can turn the
+        cache ON (starts cold — the standard two confirmation rounds),
+        OFF (every entry drops), or RESIZE it, with no service rebuild.
+        Any epoch change invalidates a surviving cache exactly as
+        before — tuned knobs change wire composition like the dispatch
+        plan cache's flush."""
+        epoch = envs.override_epoch()
+        if epoch == self._rc_epoch:
+            return
+        self._rc_epoch = epoch
+        cap = envs.response_cache_capacity(
+            getattr(self.transport, "world_size", 1))
+        rc = self._rcache
+        if cap <= 0:
+            if rc is not None:
+                rc.invalidate("knob override epoch: cache disabled")
+                self._rcache = None
+            return
+        if rc is None or rc.capacity != cap:
+            self._rcache = _rcache.ResponseCache(cap, self.pset_key)
+        else:
+            rc.invalidate("knob override epoch")
+
     def _try_serve_cached(self, requests) -> NegotiationTicket | None:
         """Answer the whole batch from the coordinator ResponseCache —
         or None to take the full negotiation path. All-or-nothing per
@@ -567,15 +595,10 @@ class DynamicService:
         in flight (a joined rank only learns of scheduled collectives
         from real rounds — serving locally would starve its zero
         executions)."""
+        self._rc_refresh_epoch()
         rc = self._rcache
         if rc is None or not requests:
             return None
-        epoch = envs.override_epoch()
-        if epoch != self._rc_epoch:
-            # knob-override epoch: tuned knobs change wire composition
-            # exactly like the dispatch plan cache's flush
-            self._rc_epoch = epoch
-            rc.invalidate("knob override epoch")
         if (self._rc_join_latch or self._joined
                 or self.engine.join_pending()):
             self._rc_join_latch = True

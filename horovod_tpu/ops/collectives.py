@@ -1505,7 +1505,7 @@ def allreduce(tensor, *, op: ReduceOp = ReduceOp.AVERAGE,
         if sig is not None:
             key = ("allreduce", name, sig, axis, pset.dispatch_key(),
                    int(op), float(prescale_factor), float(postscale_factor),
-                   hierarchical.hierarchical_enabled_for(pset))
+                   hierarchical.layout_key_for(pset))
             plan = _dispatch.lookup(key)
             if plan is None:
                 plan = _build_allreduce_plan(sig, pset, axis, op,
@@ -1629,7 +1629,7 @@ def grouped_allreduce(tensors: Sequence, *, op: ReduceOp = ReduceOp.AVERAGE,  # 
             key = ("grouped_allreduce", name, sigs, axis,
                    pset.dispatch_key(), int(op), float(prescale_factor),
                    float(postscale_factor),
-                   hierarchical.hierarchical_enabled_for(pset),
+                   hierarchical.layout_key_for(pset),
                    envs.fusion_threshold_bytes(), comp_key,
                    _pipeline_key())
             plan = _dispatch.lookup(key)
@@ -1790,7 +1790,7 @@ def allgather(tensor, *, process_set: ProcessSet | None = None,  # hvdlint: time
                 sig = None
         if sig is not None:
             key = ("allgather", name, sig, axis, pset.dispatch_key(),
-                   hierarchical.hierarchical_allgather_enabled_for(pset))
+                   hierarchical.allgather_layout_key_for(pset))
             plan = _dispatch.lookup(key)
             if plan is None:
                 plan = (_build_allgather_plan(sig, pset, axis, name)

@@ -414,7 +414,15 @@ def fold_knobs(variant: str, key: tuple, *raw_knob_values) -> tuple:
     every knob the compiled program bakes in. Override-driven knob
     changes already invalidate via the cache epoch, but a raw
     ``os.environ`` change does not bump the epoch — folding the values
-    into the key means a stale program can never replay."""
+    into the key means a stale program can never replay.
+
+    Axis-layout discipline: any plan whose compiled program bakes in a
+    mesh-axis split carries the layout in its key — the eager
+    allreduce/grouped-allreduce/allgather keys fold
+    ``hierarchical.layout_key_for(pset)`` (the composed-mesh layout
+    signature, ``parallel/mesh.py``), step capture folds the raw
+    ``HVD_MESH_AXES`` carve, and the GSPMD cache fingerprints the full
+    mesh (axis names + shape + device ids) through its shardings."""
     return (variant,) + tuple(raw_knob_values) + (key,)
 
 

@@ -25,7 +25,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -54,36 +53,27 @@ def default_ici_size() -> int:
     return n
 
 
-# (ici_size, runtime generation) -> Mesh. Meshes are immutable; caching per
-# generation mirrors ProcessSet.mesh() so the per-step eager hot path never
-# rebuilds device arrays (a stale-generation mesh would hold dead device
-# objects after shutdown()/init()).
-_mesh_cache: dict = {}
-
-
 def hierarchical_mesh(ici_size: int | None = None) -> Mesh:
     """2-D ``(dcn, ici)`` mesh over the rank-ordered global devices.
 
     Rank layout is process-major (``runtime._rank_ordered_devices``), so
     reshaping to (n // ici, ici) puts each process's chips in one ICI row
-    when ``ici_size`` == chips-per-process."""
+    when ``ici_size`` == chips-per-process.
+
+    Routed through the shared composed-mesh cache
+    (``parallel/mesh.py::mesh_for_axes``) — eager hierarchical ops and
+    composed traced steps derive their device order from the SAME
+    generation-keyed reshape of ``runtime.devices()``, so they cannot
+    silently disagree after an elastic re-form."""
     n = runtime.size()
     if ici_size is None:
         ici_size = default_ici_size()
     if ici_size <= 0 or n % ici_size != 0:
         raise ValueError(
             f"hierarchical ici_size {ici_size} must divide world size {n}")
-    key = (ici_size, runtime.generation())
-    mesh = _mesh_cache.get(key)
-    if mesh is None:
-        gen = runtime.generation()
-        for k in [k for k in _mesh_cache if k[1] != gen]:
-            del _mesh_cache[k]  # old generations hold dead device objects
-        devs = runtime.devices()
-        mesh = Mesh(np.array(devs).reshape(n // ici_size, ici_size),
-                    (DCN_AXIS, ICI_AXIS))
-        _mesh_cache[key] = mesh
-    return mesh
+    from ..parallel import mesh as composed
+    return composed.mesh_for_axes((DCN_AXIS, ICI_AXIS),
+                                  (n // ici_size, ici_size))
 
 
 # ---------------------------------------------------------------------------
@@ -231,6 +221,29 @@ def hierarchical_enabled_for(pset) -> bool:
 
 def hierarchical_allgather_enabled_for(pset) -> bool:
     return _enabled(envs.HIERARCHICAL_ALLGATHER, pset)
+
+
+def _layout_signature() -> tuple:
+    from ..parallel import mesh as composed
+    return composed.layout_signature()
+
+
+def layout_key_for(pset):
+    """Axis-layout component of allreduce/grouped-allreduce dispatch-plan
+    keys: ``False`` when the hierarchical lane is off for ``pset``
+    (exactly the old boolean key), else the active composed-mesh layout
+    signature — so a changed ``HVD_MESH_AXES`` carve or ICI size re-keys
+    every plan instead of silently replaying a stale axis layout."""
+    if not hierarchical_enabled_for(pset):
+        return False
+    return _layout_signature()
+
+
+def allgather_layout_key_for(pset):
+    """Allgather twin of :func:`layout_key_for`."""
+    if not hierarchical_allgather_enabled_for(pset):
+        return False
+    return _layout_signature()
 
 
 # ---------------------------------------------------------------------------

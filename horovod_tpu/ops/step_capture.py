@@ -403,10 +403,13 @@ def _store_key(key: tuple) -> tuple:
     changes already invalidate via the cache epoch, but a raw
     os.environ change does not bump the epoch — folding the values into
     the key means a stale layout can never replay (the eager plan keys
-    do the same)."""
+    do the same). The composed-mesh axis carve (``HVD_MESH_AXES``) is
+    folded too: a captured composed step's ICI+DCN collective stream is
+    layout-specific, and a carve change must re-record rather than
+    replay the old axis split."""
     from . import collectives as _coll
     return _dispatch.fold_knobs("step", key, envs.fusion_threshold_bytes(),
-                                _coll._pipeline_key())
+                                _coll._pipeline_key(), envs.mesh_axes())
 
 
 # Registry mirror of the capture lifecycle (docs/metrics.md): a numeric

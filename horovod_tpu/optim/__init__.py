@@ -181,14 +181,66 @@ def _bucketed_allreduce(leaves, *, op, process_set, compression,
     return out
 
 
+def _mesh_spec_sync(tree, mesh_spec, *, op, compression, prescale_factor,
+                    postscale_factor):
+    """Composed-mesh two-level gradient sync (``parallel/mesh.py``):
+    when the spec's data axes are BOUND (the step runs inside
+    ``shard_map`` over the composed mesh), every leaf reduces
+    intra-slice over ``ici_dp`` (psum_scatter) then cross-slice over
+    ``dcn`` (psum) with the standard pre/post scale split — model axes
+    (seq/expert/stage) are never touched, and ``ReduceOp.ADASUM`` rides
+    the ``dcn`` axis through the pairwise tree. Returns ``None`` when
+    the axes are not bound (an eager call): the caller falls through to
+    the bucketed eager path, keeping the PR-6 bucket pipelining and the
+    PR-8 step capture exactly as for plain DP."""
+    from ..parallel import mesh as composed
+    dcn_axis, ici_axis = composed.resolve_data_axes(mesh_spec)
+    if not (collectives._axis_is_bound(dcn_axis)
+            and collectives._axis_is_bound(ici_axis)):
+        return None
+    from ..ops import adasum as adasum_ops
+    from ..ops import hierarchical
+
+    def sync_leaf(leaf):
+        c, ctx = compression.compress(leaf)
+        if op == ReduceOp.ADASUM:
+            if prescale_factor != 1.0 or postscale_factor != 1.0:
+                raise ValueError("Adasum is scale-invariant; pre/post "
+                                 "scale factors do not apply")
+            synced = adasum_ops.adasum_hierarchical_traced(
+                c, ici_axis, dcn_axis)
+        else:
+            synced = hierarchical.hierarchical_allreduce_traced(
+                c, ici_axis, dcn_axis, op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        return compression.decompress(synced, ctx)
+
+    return jax.tree.map(sync_leaf, tree)
+
+
 def _allreduce_tree(tree, *, op, process_set, compression, prescale_factor,
                     postscale_factor, axis_name,
-                    sparse_gradient_paths=None, sparse_max_rows=None):
+                    sparse_gradient_paths=None, sparse_max_rows=None,
+                    mesh_spec=None):
     """Allreduce every leaf of a gradient pytree with dtype-fused wire
     buffers (eager) or per-leaf psum (traced; XLA fuses). Leaves whose key
     path matches ``sparse_gradient_paths`` take the indexed-rows allgather
     path instead (wire traffic ∝ touched rows — the reference's
-    IndexedSlices handling inside DistributedOptimizer)."""
+    IndexedSlices handling inside DistributedOptimizer).
+
+    ``mesh_spec`` (a ``parallel.mesh.MeshLayout`` or a
+    ``(dcn_axis, ici_dp_axis)`` name pair) routes BOUND-axis trees
+    through the composed-mesh two-level sync — every leaf dense (the
+    sparse allgather path is eager machinery); eager trees fall through
+    to the bucketed path unchanged."""
+    if mesh_spec is not None:
+        synced = _mesh_spec_sync(
+            tree, mesh_spec, op=op, compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        if synced is not None:
+            return synced
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
     if not path_leaves:
         return tree
@@ -246,7 +298,7 @@ def allreduce_gradients_transform(
         compression: type[Compressor] = Compression.none,
         prescale_factor: float = 1.0, postscale_factor: float = 1.0,
         sparse_gradient_paths=None, sparse_max_rows=None,
-        axis_name=None) -> optax.GradientTransformation:
+        axis_name=None, mesh_spec=None) -> optax.GradientTransformation:
     """An optax stage that allreduces incoming gradients."""
 
     def init_fn(params):
@@ -260,7 +312,7 @@ def allreduce_gradients_transform(
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
             sparse_gradient_paths=sparse_gradient_paths,
             sparse_max_rows=sparse_max_rows,
-            axis_name=axis_name)
+            axis_name=axis_name, mesh_spec=mesh_spec)
         return synced, state
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -274,9 +326,19 @@ def DistributedOptimizer(
         prescale_factor: float = 1.0, postscale_factor: float = 1.0,
         backward_passes_per_step: int = 1,
         sparse_gradient_paths=None, sparse_max_rows=None,
-        axis_name=None) -> optax.GradientTransformation:
+        axis_name=None, mesh_spec=None) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally-reduced gradients
     (reference ``hvd.DistributedOptimizer``).
+
+    ``mesh_spec`` opts the sync into the composed-mesh contract
+    (``parallel/mesh.py``, docs/mesh.md): pass the step's
+    ``MeshLayout`` (or an explicit ``(dcn_axis, ici_dp_axis)`` pair)
+    and a BOUND-axis step (``shard_map`` over ``hvd.composed_mesh()``)
+    reduces its gradients two-level over the DATA axes only —
+    intra-slice ``psum_scatter`` over ``ici_dp``, cross-slice ``psum``
+    over ``dcn`` — leaving sequence/expert/stage model axes sharded.
+    Eager steps with the same ``mesh_spec`` fall through to the
+    bucketed pipeline below unchanged.
 
     With ``backward_passes_per_step > 1`` gradients accumulate locally
     (running mean, matching ``average_aggregated_gradients=True``) and the
@@ -310,7 +372,7 @@ def DistributedOptimizer(
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
             sparse_gradient_paths=sparse_gradient_paths,
             sparse_max_rows=sparse_max_rows,
-            axis_name=axis_name),
+            axis_name=axis_name, mesh_spec=mesh_spec),
         optimizer,
     )
     if backward_passes_per_step > 1:
@@ -323,17 +385,20 @@ def value_and_grad(fun, argnums=0, has_aux: bool = False,
                    *, op: ReduceOp = ReduceOp.AVERAGE,
                    process_set: ProcessSet | None = None,
                    compression: type[Compressor] = Compression.none,
-                   axis_name=None):
+                   axis_name=None, mesh_spec=None):
     """``jax.value_and_grad`` whose gradients are allreduced — the
     ``DistributedGradientTape`` analog. The loss value is *not* reduced
-    (matches the reference, which only reduces gradients)."""
+    (matches the reference, which only reduces gradients).
+    ``mesh_spec`` routes bound-axis gradients through the composed-mesh
+    two-level data sync (see :func:`DistributedOptimizer`)."""
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux)
 
     def wrapped(*args, **kwargs):
         value, grads = vg(*args, **kwargs)
         grads = _allreduce_tree(
             grads, op=op, process_set=process_set, compression=compression,
-            prescale_factor=1.0, postscale_factor=1.0, axis_name=axis_name)
+            prescale_factor=1.0, postscale_factor=1.0, axis_name=axis_name,
+            mesh_spec=mesh_spec)
         return value, grads
 
     return wrapped

@@ -19,8 +19,28 @@ package makes the schedules themselves first-class for TPU:
 * :func:`pipeline_apply` — GPipe-style pipeline parallelism: one stage's
   params per chip, microbatches flowing around a ``ppermute`` ring inside
   one ``lax.scan`` (no host scheduler), optional stage rematerialization.
+* :mod:`~horovod_tpu.parallel.mesh` — the composed-mesh layer that puts
+  all of the above on ONE hierarchical device mesh (``dcn × ici_dp`` data
+  axes + optional model axes carved from the ICI island) with the
+  engine's gradient collectives reduced two-level over the data axes
+  only (docs/mesh.md).
 """
 
+from .mesh import (
+    DATA_AXES,
+    DCN_AXIS,
+    ICI_DP_AXIS,
+    MeshLayout,
+    MeshLayoutError,
+    composed_mesh,
+    default_layout,
+    layout,
+    layout_signature,
+    mesh_for_axes,
+    mesh_layout,
+    parse_axes,
+    sync_gradients,
+)
 from .moe import load_balance_loss, moe_alltoall, route_top_k
 from .pipeline import (
     microbatch,
@@ -39,4 +59,9 @@ __all__ = ["ring_attention", "ulysses_attention", "seq_to_heads",
            "heads_to_seq", "pipeline_apply", "microbatch",
            "stack_stage_params", "unstack_stage",
            "moe_alltoall", "route_top_k",
-           "load_balance_loss"]
+           "load_balance_loss",
+           "DATA_AXES", "DCN_AXIS", "ICI_DP_AXIS",
+           "MeshLayout", "MeshLayoutError", "composed_mesh",
+           "default_layout", "layout", "layout_signature",
+           "mesh_for_axes", "mesh_layout", "parse_axes",
+           "sync_gradients"]

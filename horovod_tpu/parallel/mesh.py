@@ -1,0 +1,305 @@
+"""Composed-parallelism mesh layer: ONE hierarchical device mesh.
+
+The reference Horovod composes nothing — it is data-parallel only, and
+its headline perf features (hierarchical allreduce,
+``nccl_operations.cc:286-506``; Adasum's pairwise tree) are wired
+straight into that single-axis world. Here every parallelism schedule in
+this package (``ring_attention``/``ulysses_attention`` over a sequence
+axis, ``moe_alltoall`` over an expert axis, ``pipeline_apply`` over a
+stage axis) and the engine's gradient collectives share ONE
+``jax.sharding.Mesh``, split by role:
+
+* **data axes** — ``dcn`` (cross-slice) × ``ici_dp`` (intra-slice
+  data-parallel). Gradient sync reduces ONLY over these, two-level:
+  ``psum_scatter`` over ``ici_dp`` then ``psum`` over ``dcn`` then
+  ``all_gather`` back (:func:`~horovod_tpu.ops.hierarchical.
+  hierarchical_allreduce_traced` generalized from its private 2-D mesh
+  to sub-axes of the shared mesh). Adasum's pairwise tree rides the
+  ``dcn`` axis (:func:`~horovod_tpu.ops.adasum.
+  adasum_hierarchical_traced`).
+* **model axes** — optional ``model``/``seq``/``expert``/``stage`` axes
+  carved out of the ICI dimension. The schedules run their collectives
+  over these; the gradient sync never touches them.
+
+Device order is THE contract: every mesh this module hands out reshapes
+the same rank-ordered (process-major) ``runtime.devices()`` list, cached
+per runtime generation — so the eager hierarchical ops
+(``ops/hierarchical.py`` routes its 2-D mesh through
+:func:`mesh_for_axes`) and composed traced steps can never silently
+disagree on device placement after an elastic re-form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import runtime
+from ..utils import envs
+
+# Canonical axis names. The two data axes are fixed; model axes default
+# to the canonical role names below but any non-colliding identifier is
+# accepted (a second tensor-parallel axis, say).
+DCN_AXIS = "dcn"
+ICI_DP_AXIS = "ici_dp"
+DATA_AXES = (DCN_AXIS, ICI_DP_AXIS)
+MODEL_AXIS_ROLES = ("model", "seq", "expert", "stage")
+
+
+class MeshLayoutError(ValueError):
+    """A composed-mesh layout cannot be realized on this world.
+
+    Raised when the axis-size product does not match the device count,
+    when the model-axis carve does not divide the ICI island, or when an
+    ``HVD_MESH_AXES`` spec string is malformed. Typed (rather than a
+    bare ``ValueError`` from ``numpy.reshape``) so composed train-step
+    wrappers and the bench harness can distinguish a layout mistake from
+    a numerics bug."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """One composed-mesh axis layout: ``dcn × ici_dp × model axes``.
+
+    ``model_axes`` is an ordered tuple of ``(name, size)`` pairs carved
+    from the ICI dimension (they index faster than ``ici_dp``, keeping
+    each model group inside one ICI island — model collectives stay on
+    the fast fabric, only the ``dcn`` hop crosses slices)."""
+
+    dcn: int
+    ici_dp: int
+    model_axes: tuple = ()
+
+    def __post_init__(self):
+        model = tuple((str(n), int(s)) for n, s in self.model_axes)
+        object.__setattr__(self, "model_axes", model)
+        if self.dcn < 1 or self.ici_dp < 1:
+            raise MeshLayoutError(
+                f"data axis sizes must be >= 1, got dcn={self.dcn} "
+                f"ici_dp={self.ici_dp}")
+        names = [n for n, _ in model]
+        for n, s in model:
+            if s < 1:
+                raise MeshLayoutError(f"model axis {n!r} size {s} < 1")
+            if not n.isidentifier():
+                raise MeshLayoutError(f"model axis name {n!r} is not an "
+                                      "identifier")
+        if len(set(names)) != len(names) or set(names) & set(DATA_AXES):
+            raise MeshLayoutError(
+                f"model axis names {names} must be unique and must not "
+                f"collide with the data axes {DATA_AXES}")
+
+    # -- shape ------------------------------------------------------------
+    @property
+    def axis_names(self) -> tuple:
+        return DATA_AXES + tuple(n for n, _ in self.model_axes)
+
+    @property
+    def shape(self) -> tuple:
+        return (self.dcn, self.ici_dp) + tuple(s for _, s in self.model_axes)
+
+    @property
+    def size(self) -> int:
+        return int(math.prod(self.shape))
+
+    @property
+    def data_axes(self) -> tuple:
+        """Axes the gradient sync reduces over (and nothing else does)."""
+        return DATA_AXES
+
+    @property
+    def model_axis_names(self) -> tuple:
+        return tuple(n for n, _ in self.model_axes)
+
+    def axis_size(self, name: str) -> int:
+        try:
+            return dict(zip(self.axis_names, self.shape))[name]
+        except KeyError:
+            raise MeshLayoutError(
+                f"axis {name!r} not in layout {self.axis_names}") from None
+
+    def key(self) -> tuple:
+        """Hashable identity for dispatch-plan / capture keys."""
+        return (self.dcn, self.ici_dp) + self.model_axes
+
+    # -- sharding helpers -------------------------------------------------
+    def batch_spec(self, *trailing) -> P:
+        """PartitionSpec for a batch-led array: dim 0 over BOTH data
+        axes (dcn-major, matching global rank order), trailing dims as
+        given (axis names or None)."""
+        return P(DATA_AXES, *trailing)
+
+    def replicated_spec(self) -> P:
+        return P()
+
+
+def parse_axes(spec: str) -> tuple:
+    """Parse an ``HVD_MESH_AXES``-style model-axis spec: a comma list of
+    ``name:size`` pairs, e.g. ``"seq:2"`` or ``"expert:4,stage:2"``.
+    Empty/whitespace = no model axes (pure data-parallel layout)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return ()
+    axes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, colon, size = part.partition(":")
+        try:
+            axes.append((name.strip(), int(size.strip())))
+        except ValueError:
+            raise MeshLayoutError(
+                f"bad HVD_MESH_AXES entry {part!r}; expected name:size "
+                f"(e.g. 'seq:2,expert:4')") from None
+        if not colon or not name.strip():
+            raise MeshLayoutError(
+                f"bad HVD_MESH_AXES entry {part!r}; expected name:size")
+    return tuple(axes)
+
+
+def layout(model_axes=(), *, ici_size: int | None = None,
+           world: int | None = None) -> MeshLayout:
+    """Derive a :class:`MeshLayout` for ``world`` devices: the ICI
+    island size comes from ``HVD_HIERARCHICAL_ICI_SIZE`` / topology
+    (``ops.hierarchical.default_ici_size``), ``dcn`` is the island
+    count, and the model axes are carved out of the island —
+    ``ici_dp = island / prod(model sizes)``."""
+    from ..ops import hierarchical
+    n = runtime.size() if world is None else int(world)
+    island = int(ici_size) if ici_size else hierarchical.default_ici_size()
+    if island <= 0 or n % island != 0:
+        raise MeshLayoutError(
+            f"ici island size {island} must divide world size {n}")
+    model = tuple((str(a), int(s)) for a, s in model_axes)
+    carve = math.prod(s for _, s in model) if model else 1
+    if carve <= 0 or island % carve != 0:
+        raise MeshLayoutError(
+            f"model axes {model} carve {carve} devices but the ICI "
+            f"island has {island}; the product of model-axis sizes must "
+            f"divide the island")
+    return MeshLayout(dcn=n // island, ici_dp=island // carve,
+                      model_axes=model)
+
+
+# Top-level package alias (`hvd.mesh_layout`): `layout` is too generic a
+# name next to `hvd.mesh()` (the 1-D rank mesh).
+def mesh_layout(model_axes=(), *, ici_size: int | None = None,
+                world: int | None = None) -> MeshLayout:
+    return layout(model_axes, ici_size=ici_size, world=world)
+
+
+def default_layout(*, world: int | None = None) -> MeshLayout:
+    """The layout the ``HVD_MESH_AXES`` knob describes for this world
+    (no model axes when unset — the engine's plain hierarchical-DP
+    shape)."""
+    return layout(parse_axes(envs.mesh_axes()), world=world)
+
+
+def layout_signature() -> tuple:
+    """Stable hashable identity of the ACTIVE layout for dispatch-plan /
+    step-capture keys. Never raises: an unrealizable ``HVD_MESH_AXES``
+    spec degrades to the raw spec string (the key still changes whenever
+    the knob does, which is all a cache key must guarantee)."""
+    n = runtime.size()
+    try:
+        return (n,) + default_layout(world=n).key()
+    except MeshLayoutError:
+        return (n, "unrealizable", envs.mesh_axes())
+
+
+# (axis_names, shape, runtime generation) -> Mesh. ONE cache for every
+# consumer — ops/hierarchical.py's 2-D eager mesh and the composed
+# meshes here resolve through the same rank-ordered device list, so
+# their device order cannot diverge. Stale generations are evicted (a
+# mesh from before shutdown()/init() holds dead device objects).
+_mesh_cache: dict = {}
+
+
+def mesh_for_axes(axis_names, shape) -> Mesh:
+    """THE mesh constructor: reshape the rank-ordered global devices to
+    ``shape`` with ``axis_names``. Cached per runtime generation; raises
+    :class:`MeshLayoutError` when the axis product != device count."""
+    axis_names = tuple(axis_names)
+    shape = tuple(int(s) for s in shape)
+    devs = runtime.devices()
+    if math.prod(shape) != len(devs):
+        raise MeshLayoutError(
+            f"mesh axes {dict(zip(axis_names, shape))} multiply to "
+            f"{math.prod(shape)} devices but the world has {len(devs)}")
+    key = (axis_names, shape, runtime.generation())
+    mesh = _mesh_cache.get(key)
+    if mesh is None:
+        gen = runtime.generation()
+        for k in [k for k in _mesh_cache if k[2] != gen]:
+            del _mesh_cache[k]
+        mesh = Mesh(np.array(devs).reshape(shape), axis_names)
+        _mesh_cache[key] = mesh
+    return mesh
+
+
+def composed_mesh(lay: MeshLayout | None = None) -> Mesh:
+    """The shared composed mesh for ``lay`` (default:
+    :func:`default_layout`). Axis order is dcn-major then ici_dp then
+    model axes — reshaping the process-major rank order this way keeps
+    each ICI island (and every model group within it) contiguous in
+    rank space, the same rank↔device contract as
+    :func:`~horovod_tpu.ops.hierarchical.hierarchical_mesh`."""
+    if lay is None:
+        lay = default_layout()
+    return mesh_for_axes(lay.axis_names, lay.shape)
+
+
+def resolve_data_axes(mesh_spec) -> tuple:
+    """Normalize a ``mesh_spec`` (a :class:`MeshLayout`, or an explicit
+    ``(dcn_axis, ici_axis)`` name pair) to bound data-axis names."""
+    if isinstance(mesh_spec, MeshLayout):
+        return mesh_spec.data_axes
+    if (isinstance(mesh_spec, (tuple, list)) and len(mesh_spec) == 2
+            and all(isinstance(a, str) for a in mesh_spec)):
+        return tuple(mesh_spec)
+    raise MeshLayoutError(
+        f"mesh_spec must be a MeshLayout or a (dcn_axis, ici_axis) name "
+        f"pair, got {mesh_spec!r}")
+
+
+def sync_gradients(tree, lay: MeshLayout | None = None, *,
+                   op=None, prescale_factor: float = 1.0,
+                   postscale_factor: float = 1.0):
+    """Two-level data-axis gradient sync for composed traced steps:
+    every leaf is reduced intra-slice over ``ici_dp`` (psum_scatter)
+    then cross-slice over ``dcn`` (psum), with the pre/post scale split
+    of the eager hierarchical path; model axes are untouched, so each
+    model group keeps its own shard of sequence/expert/stage state.
+    ``ReduceOp.ADASUM`` routes the cross-slice step through Adasum's
+    pairwise tree on the ``dcn`` axis instead. Call inside ``shard_map``
+    over :func:`composed_mesh` with both data axes bound."""
+    from ..ops import adasum as _adasum
+    from ..ops import hierarchical as _hier
+    from ..ops.reduce_ops import ReduceOp
+    if op is None:
+        op = ReduceOp.AVERAGE
+    dcn_axis, ici_axis = DATA_AXES if lay is None else lay.data_axes
+    if op == ReduceOp.ADASUM:
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError("Adasum is scale-invariant; pre/post scale "
+                             "factors do not apply")
+        return jax.tree.map(
+            lambda x: _adasum.adasum_hierarchical_traced(
+                x, ici_axis, dcn_axis), tree)
+    return jax.tree.map(
+        lambda x: _hier.hierarchical_allreduce_traced(
+            x, ici_axis, dcn_axis, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor), tree)
+
+
+__all__ = [
+    "DCN_AXIS", "ICI_DP_AXIS", "DATA_AXES", "MODEL_AXIS_ROLES",
+    "MeshLayout", "MeshLayoutError", "parse_axes", "layout",
+    "mesh_layout", "default_layout", "layout_signature", "mesh_for_axes",
+    "composed_mesh", "resolve_data_axes", "sync_gradients",
+]

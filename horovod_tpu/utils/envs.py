@@ -37,6 +37,7 @@ STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"
 HIERARCHICAL_ALLGATHER = "HIERARCHICAL_ALLGATHER"
 HIERARCHICAL_ICI_SIZE = "HIERARCHICAL_ICI_SIZE"  # chips per ICI island; default local_size
+MESH_AXES = "MESH_AXES"  # composed-mesh model-axis carve, e.g. "seq:2" or "expert:4,stage:2" (parallel/mesh.py)
 # (the reference's HOROVOD_BATCH_D2D_MEMCOPIES has no knob here by
 # design: XLA fuses small copies into the compiled program, so there is
 # nothing runtime-batchable to toggle)
@@ -86,7 +87,7 @@ RETRY_MAX_BACKOFF_MS = "RETRY_MAX_BACKOFF_MS"  # backoff growth cap
 RETRY_JITTER = "RETRY_JITTER"  # +/- fraction of deterministic jitter on backoff
 LOOPBACK = "LOOPBACK"  # "1" in loopback rank threads (hvd.loopback.world)
 LOOPBACK_TIMEOUT = "LOOPBACK_TIMEOUT"  # s per loopback collective rendezvous (default scales with world)
-RESPONSE_CACHE = "RESPONSE_CACHE"  # coordinator ResponseCache: 0 off, 1 on (default capacity), >1 = capacity
+RESPONSE_CACHE = "RESPONSE_CACHE"  # coordinator ResponseCache: auto = on when hierarchy active, 0 off, 1 on, >1 = capacity
 NEGOTIATION_GROUP_SIZE = "NEGOTIATION_GROUP_SIZE"  # ranks per leader group in the hierarchical control plane
 HIER_NEGOTIATION = "HIER_NEGOTIATION"  # auto|1|0: two-level leader/member negotiation exchange
 METRICS = "METRICS"  # unified metrics registry (0 = hot instruments off)
@@ -446,14 +447,27 @@ def qos_starve_limit() -> int:
     return get_int(QOS_STARVE_LIMIT, DEFAULT_QOS_STARVE_LIMIT)
 
 
+def mesh_axes() -> str:
+    """``HVD_MESH_AXES``: the composed-mesh model-axis carve
+    (``parallel/mesh.py``), a comma list of ``name:size`` pairs carved
+    out of the ICI island — e.g. ``"seq:2"`` or ``"expert:4,stage:2"``.
+    Empty (default) = no model axes: the pure data-parallel
+    ``dcn × ici_dp`` layout."""
+    return (get(MESH_AXES, "") or "").strip()
+
+
 # Hierarchical negotiation control plane (horovod_tpu/negotiation/,
 # docs/negotiation.md). Group size 8 mirrors the data path's ICI-island
 # default (ops/hierarchical.py): one leader per "island" runs the
 # cross-leader exchange while members pay O(1) KV ops per round. The
-# coordinator ResponseCache is off by default — steady-state local
-# serving changes divergence *surfacing* (a diverged rank times out
-# instead of every rank seeing the mismatch error), so it is opt-in like
-# the reference's HOROVOD_CACHE_CAPACITY tuning.
+# coordinator ResponseCache defaults to AUTO: on (default capacity)
+# whenever the hierarchical control plane is active for the world —
+# those are the worlds where steady-state batches already serve with
+# zero KV rounds and the cache's divergence-surfacing tradeoff (a
+# diverged rank times out instead of every rank seeing the mismatch
+# error) is paid for by a typed join-race error + invalidation
+# telemetry (docs/troubleshooting.md). Flat small worlds stay off, and
+# ``HVD_RESPONSE_CACHE=0`` is a hard off.
 DEFAULT_NEGOTIATION_GROUP_SIZE = 8
 DEFAULT_RESPONSE_CACHE_CAPACITY = 1024
 
@@ -463,10 +477,21 @@ def negotiation_group_size() -> int:
                           DEFAULT_NEGOTIATION_GROUP_SIZE))
 
 
-def response_cache_capacity() -> int:
-    """``HVD_RESPONSE_CACHE``: 0 (default) = off; 1 = on at the default
-    capacity; any larger value = on with that many entries."""
-    v = get_int(RESPONSE_CACHE, 0)
+def response_cache_capacity(world_size: int | None = None) -> int:
+    """``HVD_RESPONSE_CACHE``: ``auto`` (default) = on at the default
+    capacity when hierarchical negotiation is active for ``world_size``
+    (else off; ``None`` — callers without a world — reads as off);
+    ``0`` = hard off; ``1`` = on at the default capacity; any larger
+    value = on with that many entries."""
+    raw = (get(RESPONSE_CACHE, "auto") or "auto").strip().lower()
+    if raw in ("auto", ""):
+        if world_size is not None and hier_negotiation_enabled(world_size):
+            return DEFAULT_RESPONSE_CACHE_CAPACITY
+        return 0
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 0
     if v <= 0:
         return 0
     return DEFAULT_RESPONSE_CACHE_CAPACITY if v == 1 else v

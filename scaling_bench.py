@@ -203,6 +203,385 @@ def child_main(n: int, modes: list, total_batch: int, iters: int,
         print(json.dumps(out[m]))
 
 
+def _build_composed_lane(lane: str, total_batch: int, seq: int):
+    """Compile one composed-parallelism lane's TransformerLM train step.
+
+    Lanes (all world=8, float32 so the parity gates below are tight):
+
+    * ``dp``        — pure data parallel: 1-D ``data`` mesh, flat sync.
+    * ``dpsp``      — DP x SP: ``dcn=2 x ici_dp=2 x seq=2`` composed mesh,
+                      ulysses attention over ``seq``, engine sync two-level
+                      over the data axes only (``DistributedOptimizer``
+                      ``mesh_spec`` path). Ulysses reshards without changing
+                      FLOPs, so the ideal step-time ratio vs ``dp`` is 1.0.
+    * ``dpep``      — DP x EP: ``dcn=2 x ici_dp=2 x expert=2``, MoE FFN over
+                      ``expert``, two-level data-axis sync.
+    * ``dpep_flat`` — the ``dpep`` control: identical model and mesh shape
+                      but ONE flat ``data`` axis (``data=4 x expert=2``) and
+                      flat sync — isolates the two-level schedule's cost on
+                      a composed mesh (ideal ratio 1.0).
+
+    The model-axis gradient reduction (pmean over seq/expert) belongs to
+    the SCHEDULE and runs before ``tx.update``; the engine's collective
+    then reduces only over the data axes — the composed-mesh contract
+    (docs/mesh.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import parallel
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    base = dict(vocab_size=128, num_layers=2, num_heads=4, d_model=128,
+                d_ff=256, max_seq_len=seq, dtype=jnp.float32)
+    moe = lane in ("dpep", "dpep_flat")
+    if moe:
+        cfg = TransformerConfig(**base, moe_experts=2, moe_axis="expert")
+    elif lane == "dpsp":
+        cfg = TransformerConfig(**base, attn_mode="ulysses", seq_axis="seq")
+    else:
+        cfg = TransformerConfig(**base)
+    model = TransformerLM(cfg)
+
+    if lane == "dp":
+        mesh = parallel.mesh_for_axes(("data",), (8,))
+        tx = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                      axis_name="data")
+        tok_spec, model_axis = P("data"), None
+    elif lane == "dpsp":
+        lay = parallel.layout((("seq", 2),), ici_size=4)
+        mesh = parallel.composed_mesh(lay)
+        tx = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                      mesh_spec=lay)
+        tok_spec, model_axis = lay.batch_spec("seq"), "seq"
+    elif lane == "dpep":
+        lay = parallel.layout((("expert", 2),), ici_size=4)
+        mesh = parallel.composed_mesh(lay)
+        tx = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                      mesh_spec=lay)
+        tok_spec, model_axis = lay.batch_spec(), "expert"
+    elif lane == "dpep_flat":
+        mesh = parallel.mesh_for_axes(("data", "expert"), (4, 2))
+        tx = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                      axis_name="data")
+        tok_spec, model_axis = P("data"), "expert"
+    else:
+        raise ValueError(lane)
+    all_axes = mesh.axis_names
+
+    def loss_fn(p, tokens, targets):
+        if moe:
+            logits, inter = model.apply({"params": p}, tokens,
+                                        mutable=["intermediates"])
+            aux = sum(jnp.sum(a) for a in
+                      jax.tree_util.tree_leaves(inter["intermediates"]))
+        else:
+            logits, aux = model.apply({"params": p}, tokens), 0.0
+        ce = -jnp.mean(jnp.take_along_axis(
+            jax.nn.log_softmax(logits), targets[..., None], -1))
+        return ce + 0.01 * aux
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        if model_axis is not None:
+            # schedule-owned reduction over the model axis; the engine's
+            # sync below never touches it
+            grads = jax.tree.map(lambda g: lax.pmean(g, model_axis), grads)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_opt,
+                lax.pmean(loss, all_axes))
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), tok_spec, tok_spec),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, cfg.vocab_size, size=(total_batch, seq))
+    targets = np.roll(tokens, -1, axis=1)  # precomputed globally: local
+    # roll would wrap within a sequence SHARD in the dpsp lane
+    rep = NamedSharding(mesh, P())
+    # identical init params per model family: the dense lanes share one
+    # tree and the MoE lanes share another, so trajectories are comparable
+    init_model = TransformerLM(dataclasses_replace_full(cfg))
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jnp.asarray(tokens[:1]))["params"]
+    opt_state = tx.init(params)
+    return {
+        "step": step, "mesh": mesh, "moe": moe,
+        "state": dict(params=jax.device_put(params, rep),
+                      opt_state=jax.device_put(opt_state, rep)),
+        "tokens": jax.device_put(tokens, NamedSharding(mesh, tok_spec)),
+        "targets": jax.device_put(targets, NamedSharding(mesh, tok_spec)),
+    }
+
+
+def dataclasses_replace_full(cfg):
+    """Init-time twin of a lane config: same params, ``full`` attention
+    (attn_mode never changes the param tree, and init never routes, so
+    every lane of one model family inits to IDENTICAL trees)."""
+    import dataclasses
+    return dataclasses.replace(cfg, attn_mode="full")
+
+
+def _composed_sync_bit_parity(composed_lane: str):
+    """Bit-exactness gate for the composed gradient sync, in the
+    exactness domain: integer-valued float32 contributions (every
+    reduction order sums them exactly, and AVERAGE's divisors here are
+    powers of two, which are exact in binary fp) — so the composed
+    schedule (pmean over the model axis + two-level over the data axes)
+    must match the pure-DP flat pmean over one 8-wide axis BIT FOR BIT.
+    Any double-count, wrong-axis reduction, scatter-padding or scale bug
+    still breaks equality in this domain; generic-float data would add
+    ~1-ulp association noise and hide nothing extra. Shapes include an
+    odd length (33) so the two-level path's pad-to-ici_dp logic is
+    exercised."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import parallel
+    from horovod_tpu.ops.reduce_ops import ReduceOp
+
+    model_axis = {"dpsp": "seq", "dpep": "expert"}[composed_lane]
+    lay = parallel.layout(((model_axis, 2),), ici_size=4)
+    mesh_c = parallel.composed_mesh(lay)
+    mesh_f = parallel.mesh_for_axes(("data",), (8,))
+    shapes = [(33,), (4, 5), (16,)]
+
+    def contrib(r):
+        return [(jnp.arange(np.prod(s), dtype=jnp.float32).reshape(s)
+                 * 3.0 + r * 7.0) for s in shapes]
+
+    def composed_fn():
+        d = lax.axis_index("dcn")
+        i = lax.axis_index("ici_dp")
+        m = lax.axis_index(model_axis)
+        r = ((d * lay.ici_dp) + i) * 2 + m  # global rank, dcn-major
+        xs = [lax.pmean(x, model_axis) for x in contrib(r)]
+        return parallel.sync_gradients(xs, lay, op=ReduceOp.AVERAGE)
+
+    def flat_fn():
+        r = lax.axis_index("data")
+        return [lax.pmean(x, "data") for x in contrib(r)]
+
+    got = jax.jit(jax.shard_map(composed_fn, mesh=mesh_c, in_specs=(),
+                                out_specs=P(), check_vma=False))()
+    want = jax.jit(jax.shard_map(flat_fn, mesh=mesh_f, in_specs=(),
+                                 out_specs=P(), check_vma=False))()
+    return all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(got, want))
+
+
+def _grouped_two_level_parity():
+    """world=8 eager ``grouped_allreduce``: two-level (ICI-then-DCN,
+    ``HVD_HIERARCHICAL_ALLREDUCE=1``, island=4) vs flat — bitwise on
+    integer-valued float32 (exactness domain, see above) plus the max
+    relative error on gaussian data (association noise only, ~1 ulp)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    rng = np.random.default_rng(3)
+    n = hvd.size()
+    ints = [np.float32((rng.integers(-500, 500, size=s)))
+            for s in [(33,), (8, 3)]]
+    gauss = [np.float32(rng.standard_normal(s)) for s in [(33,), (8, 3)]]
+
+    def run(two_level):
+        os.environ["HVD_HIERARCHICAL_ALLREDUCE"] = "1" if two_level else "0"
+        os.environ["HVD_HIERARCHICAL_ICI_SIZE"] = "4"
+        per_int = [hvd.per_rank([x * 1.0 + r for r in range(n)])
+                   for x in ints]
+        per_g = [hvd.per_rank([x * (1.0 + 0.01 * r) for r in range(n)])
+                 for x in gauss]
+        oi = hvd.grouped_allreduce(per_int, op=hvd.ReduceOp.SUM)
+        og = hvd.grouped_allreduce(per_g, op=hvd.ReduceOp.SUM)
+        return ([np.asarray(t) for t in oi], [np.asarray(t) for t in og])
+
+    try:
+        flat_i, flat_g = run(two_level=False)
+        two_i, two_g = run(two_level=True)
+    finally:
+        os.environ.pop("HVD_HIERARCHICAL_ALLREDUCE", None)
+        os.environ.pop("HVD_HIERARCHICAL_ICI_SIZE", None)
+    bitwise = all(np.array_equal(a, b) for a, b in zip(flat_i, two_i))
+    rel = max(float(np.max(np.abs(a - b) / (np.abs(a) + 1e-6)))
+              for a, b in zip(flat_g, two_g))
+    return bitwise, rel
+
+
+def composed_child_main(total_batch: int, iters: int, seq: int,
+                        rounds: int | None = None) -> None:
+    """All four composed lanes in ONE process: numerics gates first, then
+    interleaved round-robin timing with paired per-round ratios (same
+    drift rationale as :func:`child_main`)."""
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    if rounds is None:
+        rounds = int(os.environ.get("SCALING_ROUNDS", "5"))
+    hvd.init()
+    lanes = ["dp", "dpsp", "dpep_flat", "dpep"]
+    built = {m: _build_composed_lane(m, total_batch, seq) for m in lanes}
+
+    # -- numerics gates (before timing mutates the states) ---------------
+    numerics = {
+        "dpsp_sync_bitwise": _composed_sync_bit_parity("dpsp"),
+        "dpep_sync_bitwise": _composed_sync_bit_parity("dpep"),
+    }
+    bitwise, rel = _grouped_two_level_parity()
+    numerics["grouped_two_level_bitwise"] = bitwise
+    numerics["grouped_two_level_gauss_max_rel"] = float(f"{rel:.3e}")
+
+    def run_steps(b, k, record=None):
+        s = b["state"]
+        t0 = time.perf_counter()
+        for _ in range(k):
+            p, o, loss = b["step"](s["params"], s["opt_state"],
+                                   b["tokens"], b["targets"])
+            jax.block_until_ready(loss)
+            s.update(params=p, opt_state=o)
+            if record is not None:
+                record.append(float(np.ravel(np.asarray(loss))[0]))
+        return (time.perf_counter() - t0) / k
+
+    # -- trajectory parity: identical inits, 4 recorded steps ------------
+    traj = {m: [] for m in lanes}
+    for m in lanes:
+        run_steps(built[m], 4, record=traj[m])
+    sp = np.asarray(traj["dpsp"])
+    dp = np.asarray(traj["dp"])
+    ep = np.asarray(traj["dpep"])
+    epf = np.asarray(traj["dpep_flat"])
+    numerics["dpsp_traj_max_rel"] = float(
+        f"{np.max(np.abs(sp - dp) / np.abs(dp)):.3e}")
+    # dp vs dpsp: same math, different schedule (ulysses reshard + token
+    # grouping + sync association) — float32 keeps this at ulp scale
+    numerics["dpsp_traj_ok"] = bool(np.allclose(sp, dp, rtol=1e-4,
+                                                atol=1e-6))
+    # dpep vs its flat control: identical compute, only the data-axis
+    # sync schedule differs
+    numerics["dpep_traj_max_rel"] = float(
+        f"{np.max(np.abs(ep - epf) / np.abs(epf)):.3e}")
+    numerics["dpep_traj_ok"] = bool(np.allclose(ep, epf, rtol=5e-5,
+                                                atol=1e-7))
+    numerics["row"] = "composed_numerics"
+    print(json.dumps(numerics))
+
+    # -- timing: round-robin windows, paired per-round ratios ------------
+    for b in built.values():
+        run_steps(b, 2)
+    per = {m: [] for m in lanes}
+    for _ in range(rounds):
+        for m in lanes:
+            per[m].append(run_steps(built[m], max(1, iters // rounds)))
+    eff_sp = np.asarray(per["dp"]) / np.asarray(per["dpsp"])
+    eff_ep = np.asarray(per["dpep_flat"]) / np.asarray(per["dpep"])
+    for m in lanes:
+        arr = np.asarray(per[m])
+        row = {"row": "composed_lane", "lane": m,
+               "step_ms": round(float(np.median(arr)) * 1e3, 3),
+               "step_ms_std": round(float(arr.std()) * 1e3, 3),
+               "rounds": rounds}
+        if m == "dpsp":
+            row["per_axis_efficiency"] = round(float(np.median(eff_sp)), 3)
+            row["per_axis_efficiency_std"] = round(float(eff_sp.std()), 3)
+        if m == "dpep":
+            row["per_axis_efficiency"] = round(float(np.median(eff_ep)), 3)
+            row["per_axis_efficiency_std"] = round(float(eff_ep.std()), 3)
+        print(json.dumps(row))
+
+
+def run_composed_child(total_batch: int, iters: int, seq: int) -> dict:
+    """Fresh-process composed run (8 virtual devices); returns
+    {lane rows..., numerics row}."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    for k in list(env):
+        if k.startswith(("HVD_", "HOROVOD_")):
+            env.pop(k)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_composed-child",
+         str(total_batch), str(iters), str(seq)],
+        env=env, cwd=HERE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=3600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"composed child failed:\n{proc.stderr[-4000:]}")
+    rows = {}
+    for ln in proc.stdout.strip().splitlines():
+        if not ln.startswith("{"):
+            continue
+        try:
+            row = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if row.get("row") == "composed_numerics":
+            rows["numerics"] = row
+        elif row.get("row") == "composed_lane":
+            rows[row["lane"]] = row
+    missing = [k for k in ("numerics", "dp", "dpsp", "dpep", "dpep_flat")
+               if k not in rows]
+    if missing:
+        raise RuntimeError(
+            f"composed child produced no rows for {missing}; stdout "
+            f"tail:\n{proc.stdout[-2000:]}")
+    return rows
+
+
+def composed_main(args) -> None:
+    rows = run_composed_child(args.total_batch, args.iters, args.seq)
+    num = rows["numerics"]
+    eff_sp = rows["dpsp"]["per_axis_efficiency"]
+    eff_ep = rows["dpep"]["per_axis_efficiency"]
+    out = args.out or os.path.join(HERE, "SCALING_composed_r17.json")
+    payload = {
+        "harness": "composed-parallelism lanes (TransformerLM, float32, "
+                   "world=8 virtual CPU devices) interleaved round-robin "
+                   "in ONE child with paired per-round ratios",
+        "lanes": {m: rows[m] for m in ("dp", "dpsp", "dpep_flat", "dpep")},
+        "numerics": num,
+        "metric": "per_axis_efficiency(dpsp) = median t(dp)/t(dpsp) — "
+                  "ulysses reshards without changing FLOPs so ideal is "
+                  "1.0; per_axis_efficiency(dpep) = median "
+                  "t(dpep_flat)/t(dpep), the two-level schedule's cost on "
+                  "the composed mesh, ideal 1.0. Bitwise gates run in the "
+                  "exactness domain (integer-valued float32 + power-of-two "
+                  "divisors: every correct reduction order is exact, so "
+                  "composed-vs-flat must agree bit for bit; generic floats "
+                  "would only add ~1-ulp association noise). Trajectory "
+                  "parity is paired per-step loss agreement at float32.",
+        "gates": {"per_axis_efficiency_floor": 0.80,
+                  "bitwise": ["dpsp_sync_bitwise", "dpep_sync_bitwise",
+                              "grouped_two_level_bitwise"],
+                  "trajectory": ["dpsp_traj_ok", "dpep_traj_ok"]},
+    }
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(json.dumps({
+        "metric": "composed_dpsp_per_axis_efficiency", "value": eff_sp,
+        "unit": "ratio", "dpep_per_axis_efficiency": eff_ep,
+        "dpsp_sync_bitwise": num["dpsp_sync_bitwise"],
+        "dpep_sync_bitwise": num["dpep_sync_bitwise"],
+        "grouped_two_level_bitwise": num["grouped_two_level_bitwise"],
+        "dpsp_traj_ok": num["dpsp_traj_ok"],
+        "dpep_traj_ok": num["dpep_traj_ok"],
+        "dpsp_traj_max_rel": num["dpsp_traj_max_rel"],
+        "out": out}))
+
+
 def run_child(n: int, modes: list, total_batch: int, iters: int,
               max_devices: int, model: str = "resnet") -> list:
     env = dict(os.environ)
@@ -243,6 +622,14 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--_child", nargs=5,
                         metavar=("N", "MODE", "BATCH", "ITERS", "MODEL"))
+    parser.add_argument("--_composed-child", nargs=3, dest="_composed_child",
+                        metavar=("BATCH", "ITERS", "SEQ"))
+    parser.add_argument("--composed", action="store_true",
+                        help="composed-parallelism mode: TransformerLM "
+                             "DP x SP and DP x EP lanes on one hierarchical "
+                             "world=8 mesh vs the pure-DP lane (ISSUE 17)")
+    parser.add_argument("--seq", type=int, default=64,
+                        help="sequence length for --composed")
     parser.add_argument("--devices", default="1,2,4,8")
     parser.add_argument("--total-batch", type=int, default=64)
     parser.add_argument("--iters", type=int, default=10)
@@ -256,6 +643,13 @@ def main():
     if args._child:
         n, modes, batch, iters, model = args._child
         child_main(int(n), modes.split(","), int(batch), int(iters), model)
+        return
+    if args._composed_child:
+        batch, iters, seq = args._composed_child
+        composed_child_main(int(batch), int(iters), int(seq))
+        return
+    if args.composed:
+        composed_main(args)
         return
 
     device_counts = [int(x) for x in args.devices.split(",")]

@@ -1,0 +1,426 @@
+"""Composed-parallelism mesh layer (ISSUE 17 tentpole): ONE hierarchical
+``dcn x ici_dp (x model axes)`` mesh shared by every schedule, with the
+engine's gradient collectives reduced two-level over the DATA axes only.
+
+Numerics conventions (measured on this XLA CPU backend): flat ``psum`` is
+a sequential left fold in rank order, so regrouping it two-level is a
+~1-ulp change on generic floats. The bit-parity gates therefore run in
+the EXACTNESS DOMAIN — integer-valued float32 contributions and
+power-of-two divisors, where every correct reduction order is exact and
+any wrong-axis/double-count/padding/scale bug still breaks equality —
+and trajectory parity vs pure DP is tight float32 allclose.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import parallel
+from horovod_tpu.ops import hierarchical
+from horovod_tpu.parallel import mesh as composed
+
+N = 8
+
+
+# ------------------------------------------------------------- layout unit
+
+class TestLayout:
+    def test_parse_axes(self):
+        assert composed.parse_axes("") == ()
+        assert composed.parse_axes("seq:2") == (("seq", 2),)
+        assert composed.parse_axes(" expert:4 , stage:2 ") == (
+            ("expert", 4), ("stage", 2))
+
+    @pytest.mark.parametrize("spec", ["seq", "seq:", "seq:two", ":4"])
+    def test_parse_axes_malformed_is_typed(self, spec):
+        with pytest.raises(parallel.MeshLayoutError):
+            composed.parse_axes(spec)
+
+    def test_layout_carves_model_axes_from_the_island(self):
+        lay = parallel.layout((("seq", 2),), ici_size=4, world=8)
+        assert lay.shape == (2, 2, 2)
+        assert lay.axis_names == ("dcn", "ici_dp", "seq")
+        assert lay.data_axes == ("dcn", "ici_dp")
+        assert lay.model_axis_names == ("seq",)
+        assert lay.axis_size("seq") == 2 and lay.size == 8
+        assert lay.batch_spec("seq") == P(("dcn", "ici_dp"), "seq")
+
+    def test_layout_rejects_bad_carve_and_bad_island(self):
+        with pytest.raises(parallel.MeshLayoutError):
+            parallel.layout((("seq", 3),), ici_size=4, world=8)
+        with pytest.raises(parallel.MeshLayoutError):
+            parallel.layout((), ici_size=3, world=8)
+
+    def test_layout_rejects_data_axis_collision_and_dup_names(self):
+        with pytest.raises(parallel.MeshLayoutError):
+            parallel.MeshLayout(dcn=2, ici_dp=2,
+                                model_axes=(("ici_dp", 2),))
+        with pytest.raises(parallel.MeshLayoutError):
+            parallel.MeshLayout(dcn=2, ici_dp=1,
+                                model_axes=(("m", 2), ("m", 2)))
+
+    def test_default_layout_reads_the_knob(self, monkeypatch):
+        monkeypatch.setenv("HVD_MESH_AXES", "seq:2")
+        monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        lay = parallel.default_layout(world=8)
+        assert lay.key() == (2, 2, ("seq", 2))
+        assert composed.layout_signature() == (8, 2, 2, ("seq", 2))
+
+    def test_layout_signature_never_raises(self, monkeypatch):
+        monkeypatch.setenv("HVD_MESH_AXES", "seq:5")  # 5 can't divide 8
+        sig = composed.layout_signature()
+        assert sig[1] == "unrealizable" and "seq:5" in sig[2]
+
+
+# ------------------------------------------------------------- shared mesh
+
+class TestSharedMesh:
+    def test_axis_product_mismatch_is_typed(self):
+        with pytest.raises(parallel.MeshLayoutError):
+            parallel.mesh_for_axes(("dcn", "ici_dp"), (3, 2))
+
+    def test_composed_mesh_shape_and_device_order(self):
+        lay = parallel.layout((("seq", 2),), ici_size=4, world=8)
+        m = parallel.composed_mesh(lay)
+        assert m.axis_names == ("dcn", "ici_dp", "seq")
+        # dcn-major reshape of the rank-ordered device list: coords
+        # (d, i, s) hold global rank ((d*2)+i)*2+s
+        flat = list(np.asarray(m.devices).ravel())
+        assert flat == list(hvd.devices())
+
+    def test_hierarchical_mesh_routes_through_the_shared_cache(self):
+        # satellite 2: the eager 2-D hierarchical mesh and the composed
+        # layer resolve through ONE generation-keyed cache, so device
+        # order cannot diverge after an elastic re-form
+        m1 = hvd.hierarchical_mesh(ici_size=4)
+        m2 = parallel.mesh_for_axes(
+            (hierarchical.DCN_AXIS, hierarchical.ICI_AXIS), (2, 4))
+        assert m1 is m2
+        assert hvd.hierarchical_mesh(ici_size=4) is m1
+
+    def test_stale_generation_entries_are_evicted(self):
+        from horovod_tpu import runtime
+
+        live = parallel.mesh_for_axes(("dcn", "ici_dp"), (2, 4))
+        stale = (("dcn", "ici_dp"), (2, 4), -1)  # impossible generation
+        composed._mesh_cache[stale] = live
+        parallel.mesh_for_axes(("gen_probe",), (8,))  # any miss evicts
+        assert stale not in composed._mesh_cache
+        assert (("dcn", "ici_dp"), (2, 4),
+                runtime.generation()) in composed._mesh_cache
+
+
+# ----------------------------------------------- sync bit-parity (exact)
+
+def _sync_bit_parity(model_axis):
+    """Composed sync (pmean over the model axis + two-level over the data
+    axes) vs pure-DP flat pmean over one 8-wide axis, in the exactness
+    domain — must agree BIT FOR BIT. Includes an odd length (33) so the
+    two-level pad-to-ici_dp path is exercised."""
+    lay = parallel.layout(((model_axis, 2),), ici_size=4, world=8)
+    mesh_c = parallel.composed_mesh(lay)
+    mesh_f = parallel.mesh_for_axes(("data",), (N,))
+    shapes = [(33,), (4, 5)]
+
+    def contrib(r):
+        return [jnp.arange(np.prod(s), dtype=jnp.float32).reshape(s) * 3.0
+                + r * 7.0 for s in shapes]
+
+    def composed_fn():
+        d, i = lax.axis_index("dcn"), lax.axis_index("ici_dp")
+        m = lax.axis_index(model_axis)
+        r = ((d * lay.ici_dp) + i) * 2 + m
+        xs = [lax.pmean(x, model_axis) for x in contrib(r)]
+        return parallel.sync_gradients(xs, lay, op=hvd.ReduceOp.AVERAGE)
+
+    def flat_fn():
+        return [lax.pmean(x, "data")
+                for x in contrib(lax.axis_index("data"))]
+
+    got = jax.jit(jax.shard_map(composed_fn, mesh=mesh_c, in_specs=(),
+                                out_specs=P(), check_vma=False))()
+    want = jax.jit(jax.shard_map(flat_fn, mesh=mesh_f, in_specs=(),
+                                 out_specs=P(), check_vma=False))()
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dpsp_sync_bit_identical_to_pure_dp():
+    _sync_bit_parity("seq")
+
+
+def test_dpep_sync_bit_identical_to_pure_dp():
+    _sync_bit_parity("expert")
+
+
+def test_sync_gradients_adasum_rides_dcn_and_rejects_scales():
+    lay = parallel.layout((), ici_size=4, world=8)
+    mesh = parallel.composed_mesh(lay)
+    data = np.arange(N * 6, dtype=np.float32).reshape(N, 6)
+
+    def fn(x):
+        return parallel.sync_gradients([x[0]], lay,
+                                       op=hvd.ReduceOp.ADASUM)[0][None]
+
+    out = np.asarray(jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=P(("dcn", "ici_dp")),
+        out_specs=P(("dcn", "ici_dp")), check_vma=False))(data))
+    # all ranks agree and the result is finite (Adasum's magnitude is
+    # direction-dependent, not a plain mean)
+    assert np.isfinite(out).all()
+    for r in range(1, N):
+        np.testing.assert_array_equal(out[0], out[r])
+    with pytest.raises(ValueError):
+        parallel.sync_gradients([jnp.ones(3)], lay,
+                                op=hvd.ReduceOp.ADASUM, prescale_factor=2.0)
+
+
+def test_resolve_data_axes_rejects_junk():
+    assert composed.resolve_data_axes(("a", "b")) == ("a", "b")
+    with pytest.raises(parallel.MeshLayoutError):
+        composed.resolve_data_axes("dcn")
+
+
+# -------------------------------------- grouped two-level vs flat (world=8)
+
+def test_two_level_grouped_allreduce_matches_flat_exactly(monkeypatch):
+    """Eager grouped_allreduce, two-level (ICI-then-DCN) vs flat at
+    world=8: bitwise on integer-valued float32, ~1-ulp on gaussian."""
+    rng = np.random.default_rng(5)
+    ints = [np.float32(rng.integers(-400, 400, size=s))
+            for s in [(33,), (8, 3), (64,)]]
+    gauss = [np.float32(rng.standard_normal(s)) for s in [(33,), (8, 3)]]
+
+    def run(two_level, tensors):
+        if two_level:
+            monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+            monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+        else:
+            monkeypatch.delenv("HVD_HIERARCHICAL_ALLREDUCE", raising=False)
+        per = [hvd.per_rank([x * 1.0 + r for r in range(N)])
+               for x in tensors]
+        return [np.asarray(t)
+                for t in hvd.grouped_allreduce(per, op=hvd.ReduceOp.SUM)]
+
+    for a, b in zip(run(False, ints), run(True, ints)):
+        np.testing.assert_array_equal(a, b)  # exactness domain: bitwise
+    for a, b in zip(run(False, gauss), run(True, gauss)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+# --------------------------------------------- composed TransformerLM step
+
+def _lm(attn_mode="full", moe=0, **over):
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+    base = dict(vocab_size=32, num_layers=1, num_heads=2, d_model=16,
+                d_ff=32, max_seq_len=8, dtype=jnp.float32)
+    base.update(over)
+    if moe:
+        cfg = TransformerConfig(**base, moe_experts=moe, moe_axis="expert")
+    elif attn_mode != "full":
+        cfg = TransformerConfig(**base, attn_mode=attn_mode, seq_axis="seq")
+    else:
+        cfg = TransformerConfig(**base)
+    return TransformerLM(cfg), cfg
+
+
+def _composed_lm_steps(lane, tokens, targets, steps=3):
+    """Run `steps` SGD steps of one lane from a fixed init; returns
+    (losses, final embed table). Lanes: dp (flat 8-wide mesh), dpsp
+    (dcn=2 x ici_dp=2 x seq=2, ulysses, DistributedOptimizer mesh_spec),
+    dpep (dcn=2 x ici_dp=2 x expert=2, MoE FFN), dpep_flat (data=4 x
+    expert=2, flat data sync — the dpep control)."""
+    moe = lane in ("dpep", "dpep_flat")
+    if lane == "dp":
+        model, cfg = _lm()
+        mesh = parallel.mesh_for_axes(("data",), (N,))
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="data")
+        tok_spec, model_axis = P("data"), None
+    elif lane == "dpsp":
+        model, cfg = _lm(attn_mode="ulysses")
+        lay = parallel.layout((("seq", 2),), ici_size=4, world=8)
+        mesh = parallel.composed_mesh(lay)
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1), mesh_spec=lay)
+        tok_spec, model_axis = lay.batch_spec("seq"), "seq"
+    elif lane == "dpep":
+        model, cfg = _lm(moe=2)
+        lay = parallel.layout((("expert", 2),), ici_size=4, world=8)
+        mesh = parallel.composed_mesh(lay)
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1), mesh_spec=lay)
+        tok_spec, model_axis = lay.batch_spec(), "expert"
+    else:
+        model, cfg = _lm(moe=2)
+        mesh = parallel.mesh_for_axes(("data", "expert"), (4, 2))
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="data")
+        tok_spec, model_axis = P("data"), "expert"
+    axes = mesh.axis_names
+
+    def loss_fn(p, t, tgt):
+        if moe:
+            logits, inter = model.apply({"params": p}, t,
+                                        mutable=["intermediates"])
+            aux = sum(jnp.sum(a) for a in
+                      jax.tree_util.tree_leaves(inter["intermediates"]))
+        else:
+            logits, aux = model.apply({"params": p}, t), 0.0
+        ce = -jnp.mean(jnp.take_along_axis(
+            jax.nn.log_softmax(logits), tgt[..., None], -1))
+        return ce + 0.01 * aux
+
+    def train_step(p, o, t, tgt):
+        loss, g = jax.value_and_grad(loss_fn)(p, t, tgt)
+        if model_axis is not None:
+            g = jax.tree.map(lambda x: lax.pmean(x, model_axis), g)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, lax.pmean(loss, axes)
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh, in_specs=(P(), P(), tok_spec, tok_spec),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    # init with attn_mode=full: never routes, same param tree per family
+    init_model, _ = _lm(moe=2) if moe else _lm()
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jnp.asarray(tokens[:1]))["params"]
+    opt = tx.init(params)
+    t = jax.device_put(tokens, NamedSharding(mesh, tok_spec))
+    tgt = jax.device_put(targets, NamedSharding(mesh, tok_spec))
+    losses = []
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, t, tgt)
+        losses.append(float(np.ravel(np.asarray(loss))[0]))
+    return losses, np.asarray(params["embed"]["embedding"])
+
+
+@pytest.fixture()
+def lm_batch():
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 32, size=(8, 8))
+    return tokens, np.roll(tokens, -1, axis=1)  # global roll: a local
+    # roll would wrap within a sequence SHARD in the dpsp lane
+
+
+def test_dpsp_trains_like_pure_dp(lm_batch):
+    """DP x SP composed step (ulysses over seq, two-level data sync via
+    the DistributedOptimizer mesh_spec path) tracks the pure-DP
+    trajectory at float32 ulp scale."""
+    tokens, targets = lm_batch
+    dp_losses, dp_emb = _composed_lm_steps("dp", tokens, targets)
+    sp_losses, sp_emb = _composed_lm_steps("dpsp", tokens, targets)
+    np.testing.assert_allclose(sp_losses, dp_losses, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(sp_emb, dp_emb, rtol=1e-3, atol=1e-5)
+    assert dp_losses[-1] < dp_losses[0]  # it actually trains
+
+
+def test_dpep_trains_like_flat_data_sync(lm_batch):
+    """DP x EP composed step vs its flat-data-sync control: identical
+    compute, only the data-axis sync schedule differs."""
+    tokens, targets = lm_batch
+    f_losses, f_emb = _composed_lm_steps("dpep_flat", tokens, targets)
+    c_losses, c_emb = _composed_lm_steps("dpep", tokens, targets)
+    np.testing.assert_allclose(c_losses, f_losses, rtol=5e-5, atol=1e-7)
+    np.testing.assert_allclose(c_emb, f_emb, rtol=1e-3, atol=1e-5)
+
+
+# -------------------------------------------------- step capture (eager)
+
+def test_composed_eager_step_records_and_replays(hvd, monkeypatch):
+    """A composed eager step — the two-level ICI+DCN stream under a step
+    marker with the mesh-axes knob set — records once and REPLAYS with no
+    steady-state fallback; flipping HVD_MESH_AXES re-records under the
+    new layout key instead of wrongly replaying the old plan."""
+    import horovod_tpu.ops.fusion_cycle as fusion_cycle
+    from horovod_tpu.ops import dispatch_cache
+
+    monkeypatch.setenv("HVD_CYCLE_TIME", "2000")
+    monkeypatch.setenv("HVD_PENDING_CYCLE_TIME", "2000")
+    monkeypatch.setenv("HVD_STEP_CAPTURE", "1")
+    monkeypatch.setenv("HVD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HVD_HIERARCHICAL_ICI_SIZE", "4")
+    monkeypatch.setenv("HVD_MESH_AXES", "seq:2")
+    fusion_cycle.reset()
+    dispatch_cache.reset()
+    try:
+        def one_step(mult):
+            with hvd.step_marker():
+                handles = []
+                for i, shp in enumerate([(48,), (33,)]):
+                    t = hvd.per_rank([jnp.full(shp, (r + 1) * mult * (i + 1),
+                                               jnp.float32)
+                                      for r in range(N)])
+                    h = hvd.allreduce_async(t, op=hvd.Sum)
+                    h.flush()
+                    handles.append(h)
+                return [np.asarray(h.synchronize()) for h in handles]
+
+        first = one_step(1.0)
+        for k in range(2, 5):
+            out = one_step(float(k))  # replays the sealed program
+            for a, b in zip(out, first):
+                np.testing.assert_allclose(a, b * k, rtol=1e-6)
+        st = hvd.fusion_stats()["capture"]
+        assert st["recorded_steps"] == 1
+        assert st["replayed_steps"] == 3
+        assert st["fallbacks"] == 0
+
+        # layout flip: the step key folds envs.mesh_axes(), so the same
+        # stream under a new layout re-records (no false replay, no
+        # fallback)
+        monkeypatch.setenv("HVD_MESH_AXES", "expert:2")
+        one_step(1.0)
+        one_step(2.0)
+        st = hvd.fusion_stats()["capture"]
+        assert st["recorded_steps"] == 2
+        assert st["replayed_steps"] == 4
+        assert st["fallbacks"] == 0
+    finally:
+        fusion_cycle.reset()
+        dispatch_cache.reset()
+
+
+# ------------------------------------------------ gspmd cache composition
+
+def test_cached_step_accepts_composed_mesh_shardings(hvd):
+    """hvd.cached_step with composed-mesh shardings: recreated closures
+    share ONE program (the signature fingerprints the full mesh), and
+    moving the same arrays to a different layout is a miss, not a stale
+    hit."""
+    from horovod_tpu.ops import dispatch_cache, gspmd_cache
+
+    dispatch_cache.reset()
+    gspmd_cache.reset_stats()
+    try:
+        lay = parallel.layout((("seq", 2),), ici_size=4, world=8)
+        mesh_c = parallel.composed_mesh(lay)
+        mesh_f = parallel.mesh_for_axes(("data",), (N,))
+
+        def make_step():
+            def train_step(params, x):
+                return jax.tree.map(lambda p: p - 0.1 * x.mean(), params)
+            return train_step
+
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        x_c = jax.device_put(jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+                             NamedSharding(mesh_c, lay.batch_spec()))
+        s1 = hvd.cached_step(make_step())
+        out1 = s1(params, x_c)
+        assert dispatch_cache.stats()["gspmd_builds"] == 1
+        s2 = hvd.cached_step(make_step())  # fresh closure, same content
+        out2 = s2(params, x_c)
+        assert dispatch_cache.stats()["gspmd_builds"] == 1
+        assert dispatch_cache.stats()["hits_by_source"].get("gspmd", 0) == 1
+        np.testing.assert_array_equal(np.asarray(out1["w"]),
+                                      np.asarray(out2["w"]))
+
+        x_f = jax.device_put(np.asarray(x_c),
+                             NamedSharding(mesh_f, P("data")))
+        s2(params, x_f)  # layout drift -> second program, coexisting
+        assert dispatch_cache.stats()["gspmd_builds"] == 2
+    finally:
+        dispatch_cache.reset()
+        gspmd_cache.reset_stats()

@@ -903,6 +903,56 @@ class TestRankDivergence:
         found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
         assert found == []
 
+    def test_trips_on_data_axis_index_queries(self, tmp_path):
+        # ISSUE 17: the composed-mesh layer makes "my coordinate in the
+        # gradient-sync group" as reachable as rank() — axis_index on a
+        # data axis (literal or canonical constant) and mesh coordinate
+        # lookups taint exactly like rank()
+        src = """
+            from jax import lax
+            from ..parallel.mesh import DATA_AXES, DCN_AXIS
+
+            def two_level(h):
+                if lax.axis_index("ici_dp") == 0:
+                    h.allreduce_async([1.0], name="cross")
+
+            def cross_slice(h, entry):
+                d = lax.axis_index(DCN_AXIS)
+                if d > 0:
+                    h.flush_entry(entry)
+
+            def subscripted(h):
+                if lax.axis_index(DATA_AXES[0]) == 0:
+                    h.allreduce_async([1.0])
+
+            def coords(h, mesh, dev, entry):
+                if mesh.coords_of(dev)[0] == 0:
+                    h.flush_entry(entry)
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"bad.py": src})
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 4, msgs
+        assert "on a data axis" in msgs
+        assert "mesh coordinate lookup" in msgs
+
+    def test_model_axis_index_queries_stay_legal(self, tmp_path):
+        # a schedule's own positioning math — axis_index over a MODEL
+        # axis (cfg.seq_axis / "expert") or a variable axis name — is
+        # legal traced compute, not submission-conditioning divergence
+        src = """
+            from jax import lax
+
+            def schedule(h, cfg, axis):
+                if lax.axis_index(cfg.seq_axis) == 0:
+                    h.allreduce_async([1.0], name="pos")
+                if lax.axis_index("expert") == 0:
+                    h.allreduce_async([1.0], name="route")
+                if lax.axis_index(axis) == 0:
+                    h.allreduce_async([1.0], name="var")
+        """
+        found = findings_for(tmp_path, "rank-divergence", {"ok.py": src})
+        assert found == []
+
     def test_pragma_suppresses(self, tmp_path):
         src = """
             from ..core import rank

@@ -483,6 +483,61 @@ class TestServiceResponseCache:
         finally:
             self._teardown(world, svcs)
 
+    def test_mid_job_flip_on_resize_off_via_knob_epoch(self, monkeypatch):
+        """Default-on rollout ergonomics: HVD_RESPONSE_CACHE flips land
+        at the next knob-override epoch with NO service rebuild — ON
+        starts cold (standard confirmation rounds), RESIZE rebuilds at
+        the new capacity, OFF drops every entry and the flat protocol
+        keeps negotiating."""
+        from horovod_tpu.utils import envs
+        world, svcs = self._services(monkeypatch, cache="0")
+        # Unpin the env var: an env-set knob is FIXED (overrides lose to
+        # the environment) — mid-job flips are an override-epoch feature.
+        monkeypatch.delenv("HVD_RESPONSE_CACHE")
+        try:
+            self._negotiate_all(svcs, "flip")
+            for s in svcs:
+                assert s._rcache is None
+                assert s.response_cache_stats() is None
+
+            envs.set_override("RESPONSE_CACHE", "1")
+            assert self._warm_until_confirmed(svcs, "flip"), \
+                [s.response_cache_stats() for s in svcs]
+            base = [s.response_cache_stats()["hits"] for s in svcs]
+            self._negotiate_all(svcs, "flip")
+            for s, b in zip(svcs, base):
+                assert s.response_cache_stats()["hits"] == b + 1
+
+            envs.set_override("RESPONSE_CACHE", "64")
+            self._negotiate_all(svcs, "flip")  # epoch applies at submit
+            for s in svcs:
+                assert s._rcache is not None and s._rcache.capacity == 64
+                # resize = rebuilt cache: counters start from zero (the
+                # still-warm NATIVE caches may re-confirm in one round,
+                # but nothing has been SERVED from the new cache yet)
+                assert s.response_cache_stats()["hits"] == 0
+
+            envs.set_override("RESPONSE_CACHE", "0")
+            for _ in range(2):
+                resps = self._negotiate_all(svcs, "flip")
+                assert all(r.tensor_names == ["flip"] for r in resps)
+            for s in svcs:
+                assert s._rcache is None
+                assert s.response_cache_stats() is None
+        finally:
+            envs.clear_override("RESPONSE_CACHE")
+            self._teardown(world, svcs)
+
+    def test_auto_capacity_tracks_hierarchy_regime(self):
+        """`auto` (the default) turns the cache on exactly in the
+        pod-scale regime: world > HVD_NEGOTIATION_GROUP_SIZE."""
+        from horovod_tpu.utils import envs
+        group = envs.negotiation_group_size()
+        assert envs.response_cache_capacity(None) == 0
+        assert envs.response_cache_capacity(group) == 0
+        assert (envs.response_cache_capacity(group * 2)
+                == envs.DEFAULT_RESPONSE_CACHE_CAPACITY)
+
 
 # ---------------------------------------------------------------------------
 # loopback worlds: flat ↔ hierarchical parity, cache under join

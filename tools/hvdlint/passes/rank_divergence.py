@@ -19,8 +19,12 @@ runtime-state** read — ``fusion_stats()`` / ``qos_stats()`` /
 ``dispatch_cache_stats()`` / ``health_stats()`` / ``metrics_dump()``,
 whose values track per-rank completion timing, so a collective
 conditioned on them is the same mismatched-collective hang class as a
-rank-conditioned one — or a local name assigned from one), or inside a
-``for`` over an obvious ``set`` value (unordered iteration diverges
+rank-conditioned one — a **mesh-axis-index query on a data axis**
+(``jax.lax.axis_index`` with a data-axis literal/constant, or a mesh
+coordinate lookup; the composed-mesh layer of ISSUE 17 makes "my
+coordinate in the gradient-sync group" as reachable as ``rank()``, and
+it diverges identically), or a local name assigned from one), or inside
+a ``for`` over an obvious ``set`` value (unordered iteration diverges
 submission *order* across ranks even when the call count matches).
 Static QoS *configuration* reads (``qos.get_class`` /
 ``set_qos`` weights, priorities, quotas) stay legal: they are pure
@@ -78,7 +82,34 @@ _POLICY_STATE_ATTRS = {"last_decision", "decisions"}
 # members_of(g), leader_of(g) with a literal group) stay legal: every
 # rank computes the same value from the same (world, G).
 _LEADER_CALLS = {"is_leader", "is_group_leader", "leads"}
+# composed-mesh data-axis coordinates (ISSUE 17, parallel/mesh.py): a
+# mesh-axis-index query on a DATA axis — ``jax.lax.axis_index("dcn")``,
+# or spelled through the canonical axis constants — is this rank's
+# coordinate within the gradient-sync group, rank-local exactly like
+# ``rank()``. Model-axis queries (a schedule's own
+# ``axis_index(cfg.seq_axis)`` positioning math, transformer.py /
+# parallel/{sequence,moe,pipeline}.py) are legal traced compute and are
+# NOT matched: only string-literal data-axis names and the canonical
+# data-axis constants taint. Mesh *coordinate lookups* (resolving a
+# device's coordinates in the composed mesh) taint regardless of axis —
+# the answer is per-device by construction.
+_DATA_AXIS_LITERALS = {"hvd", "dcn", "ici_dp", "hvd_dcn", "hvd_ici"}
+_DATA_AXIS_CONSTS = {"AXIS_NAME", "DCN_AXIS", "ICI_DP_AXIS", "ICI_AXIS",
+                     "DATA_AXES"}
+_MESH_COORD_CALLS = {"coords_of", "device_coords", "mesh_coords"}
 _SUBMIT_NAMES = {"flush_entry", "negotiate_many_submit"}
+
+
+def _is_data_axis_expr(expr: ast.AST | None) -> bool:
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value in _DATA_AXIS_LITERALS
+    if isinstance(expr, ast.Subscript):  # DATA_AXES[0] etc.
+        base = dotted_name(expr.value)
+        return base is not None and base.split(".")[-1] == "DATA_AXES"
+    name = dotted_name(expr)
+    return name is not None and name.split(".")[-1] in _DATA_AXIS_CONSTS
 
 
 def _taint_call(node: ast.AST) -> str | None:
@@ -98,6 +129,13 @@ def _taint_call(node: ast.AST) -> str | None:
     if last in _LEADER_CALLS:
         return (f"{name}() (leader-role state: leadership is rank-local; "
                 "only the static group layout's shape is symmetric)")
+    if last == "axis_index" and _is_data_axis_expr(
+            node.args[0] if node.args else None):
+        return (f"{name}() on a data axis (this rank's coordinate in the "
+                "gradient-sync group — rank-local like rank(); model-axis "
+                "queries are schedule math and stay legal)")
+    if last in _MESH_COORD_CALLS:
+        return f"{name}() (mesh coordinate lookup: per-device by construction)"
     return None
 
 
