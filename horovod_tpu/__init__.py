@@ -130,6 +130,8 @@ from .health import health_stats
 from .engine_service import response_cache_stats
 from . import metrics
 from .metrics import metrics_dump
+from . import conformance
+from .conformance import conformance_dump, conformance_stats
 from .timeline import start_timeline, stop_timeline
 from . import autotune
 from . import callbacks
@@ -192,6 +194,7 @@ __all__ = [
     "PeerFailureError", "QosAdmissionError", "QosClass", "qos",
     "qos_stats", "set_qos", "health_stats", "response_cache_stats",
     "metrics", "metrics_dump",
+    "conformance", "conformance_dump", "conformance_stats",
     "start_timeline", "stop_timeline", "autotune", "callbacks",
     "checkpoint", "data", "elastic", "loopback", "parallel",
     "average_metrics",

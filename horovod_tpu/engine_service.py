@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 import time
 
+from . import conformance as _conformance
 from . import health as _health
 from . import metrics as _metrics
 from . import timeline as _timeline
@@ -166,6 +167,10 @@ class DynamicService:
         self.engine = engine
         self.transport = transport
         self.pset_key = pset_key  # metrics process_set label
+        _conformance.record(
+            "engine_service.py::DynamicService.__init__", "svc_start",
+            (pset_key, getattr(transport, "world_size", 1),
+             getattr(transport, "rank", 0)))
         # Idle-cadence default scales with world size: every member's
         # cycle thread exchanges every tick (the rounds are lockstep),
         # so a 64-rank world at the 20 ms small-world cadence would put
@@ -324,6 +329,8 @@ class DynamicService:
         from .dynamic import REQ_JOIN
         self._joined = True
         self._rc_join_latch = True  # see __init__: joins end local serving
+        _conformance.record("engine_service.py::DynamicService.join",
+                            "join", (self.pset_key, name))
         try:
             resp = self.negotiate(name, REQ_JOIN,
                                   timeout=timeout if timeout is not None
@@ -516,6 +523,8 @@ class DynamicService:
                         pass  # engine may already be torn down
 
     def stop(self):
+        _conformance.record("engine_service.py::DynamicService.stop",
+                            "svc_stop", (self.pset_key,))
         # Elastic warm re-form: a GRACEFULLY stopping service (re-form
         # teardown — no failure recorded) shelves its coordinator-cache
         # entries under its shape key; the same-shape successor restores
@@ -678,6 +687,9 @@ class DynamicService:
             owed = sorted(self._pending)
         exc = _health.make_peer_failure_error(dead_rank, reason, owed)
         _timeline.record_health_event(f"PEER_DEAD.{dead_rank}")
+        _conformance.record(
+            "engine_service.py::DynamicService._on_peer_failure",
+            "svc_abort", (self.pset_key, dead_rank))
         # A failure decision on a peer that announced a GRACEFUL
         # departure is not a broken world — owed work still fails fast
         # below, but the confirmed coordinator-cache entries (proven

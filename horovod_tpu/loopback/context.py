@@ -99,6 +99,16 @@ def current() -> RankContext | None:
     return getattr(_tls, "ctx", None)
 
 
+def current_rank_label() -> str:
+    """``"rankN"`` for the calling loopback rank thread, ``""`` on the
+    process-wide world — THE shared derivation of the per-rank display
+    label. The timeline's loopback lane prefix and the conformance
+    recorder's trace labels both read it from here instead of keeping
+    their own copies of the ``current().rank`` dance."""
+    ctx = current()
+    return f"rank{ctx.rank}" if ctx is not None else ""
+
+
 class activate:
     """Bind ``ctx`` to the current thread for the with-block (re-entrant:
     the previous binding is restored on exit)."""

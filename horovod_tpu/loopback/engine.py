@@ -135,6 +135,12 @@ def _abrupt_stop(ctx: _ctx.RankContext, reason: str,
         except Exception:
             hvd_logging.exception(
                 "loopback: notification teardown failed")
+    # Abort-path conformance dump (docs/conformance.md): the dying
+    # rank's decision trace is exactly what a post-mortem hvdtrace diff
+    # against the survivors needs. maybe_dump never raises; ctx routes
+    # the lookup since the supervisor calls this off-thread.
+    from .. import conformance as _conformance
+    _conformance.maybe_dump("abort", ctx=ctx)
 
 
 def _worker(world, ctx: _ctx.RankContext, fn, out: Outcome,

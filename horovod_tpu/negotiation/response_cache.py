@@ -37,6 +37,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from .. import conformance as _conformance
 from .. import metrics as _metrics
 from ..dynamic import (
     REQ_ALLGATHER,
@@ -149,6 +150,7 @@ class ResponseCache:
 
     def __init__(self, capacity: int, pset_key: str = "global"):
         self.capacity = int(capacity)
+        self._pset = pset_key
         self._mu = threading.Lock()
         self._entries: "OrderedDict[str, tuple[tuple, _Entry]]" = \
             OrderedDict()  # name -> (signature, entry)
@@ -195,14 +197,27 @@ class ResponseCache:
         with self._mu:
             held = self._entries.get(req["name"])
             if held is not None and held[0] == sig:
+                flipped = resp.from_cache and not held[1].confirmed
                 held[1].confirmed = held[1].confirmed or resp.from_cache
                 held[1].response = resp
                 self._entries.move_to_end(req["name"])
-                return
-            self._entries[req["name"]] = (sig, _Entry(resp, resp.from_cache))
-            self._entries.move_to_end(req["name"])
-            while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+            else:
+                flipped = resp.from_cache
+                self._entries[req["name"]] = (
+                    sig, _Entry(resp, resp.from_cache))
+                self._entries.move_to_end(req["name"])
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+            if flipped:
+                # Lockstep decision point (docs/conformance.md): the
+                # AND-ed bit vector flips every rank from "negotiate"
+                # to "serve" at the same negotiation index — a rank
+                # confirming a different name (or at a different point
+                # in the stream) IS the divergence hvdtrace localizes.
+                _conformance.record(
+                    "negotiation/response_cache.py::"
+                    "ResponseCache.note_response",
+                    "confirm", (self._pset, req["name"]))
 
     # -- accounting (service-side decisions) -------------------------------
 
@@ -210,6 +225,13 @@ class ResponseCache:
         with self._mu:
             self._hits += n
             self._served_batches += 1
+            # Lockstep decision point (docs/conformance.md): serve
+            # decisions are all-or-nothing per batch and must flip at
+            # the same serve index on every rank.
+            _conformance.record(
+                "negotiation/response_cache.py::"
+                "ResponseCache.count_served",
+                "served", (self._pset, n, self._served_batches))
         self._m_hits.inc(n)
 
     def count_missed(self, n: int) -> None:
@@ -242,6 +264,12 @@ class ResponseCache:
                     break
                 self._entries[name] = (sig, _Entry(resp, False, warm=True))
                 n += 1
+        # Local event (docs/conformance.md): restore counts are
+        # legitimately rank-asymmetric (a fresh member has no shelf) —
+        # FSM-ordered per rank, never chained cross-rank.
+        _conformance.record(
+            "negotiation/response_cache.py::ResponseCache.restore_warm",
+            "warm_restore", (self._pset, n))
         return n
 
     def warm_count(self) -> int:
@@ -277,6 +305,11 @@ class ResponseCache:
                     e.warm = False
                     e.confirmed = True
                     n += 1
+        # Local event: FSM rule — a confirm requires a preceding
+        # restore in this rank's trace (docs/conformance.md).
+        _conformance.record(
+            "negotiation/response_cache.py::ResponseCache.confirm_warm",
+            "warm_confirm", (self._pset, n))
         return n
 
     def drop_warm(self) -> int:
@@ -287,6 +320,11 @@ class ResponseCache:
                      if held[1].warm]
             for name in stale:
                 del self._entries[name]
+        # Local event: the cold-path fallback decision (veto or digest
+        # failure) — FSM-ordered per rank (docs/conformance.md).
+        _conformance.record(
+            "negotiation/response_cache.py::ResponseCache.drop_warm",
+            "warm_drop", (self._pset, len(stale)))
         return len(stale)
 
     # -- invalidation ------------------------------------------------------

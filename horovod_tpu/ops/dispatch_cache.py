@@ -41,7 +41,10 @@ import threading
 from collections import OrderedDict
 from typing import Callable
 
+import zlib as _zlib
+
 from .. import autotune as _autotune
+from .. import conformance as _conformance
 from .. import metrics as _metrics
 from .. import timeline as _timeline
 from ..utils import envs
@@ -203,6 +206,9 @@ def shelve_for_reform() -> int:
         cap = _shelf_cap()
         while len(_shelf) > cap:
             _shelf.popitem(last=False)
+        _conformance.record(
+            "ops/dispatch_cache.py::shelve_for_reform", "shelve",
+            (shape, len(keep)))
         return len(keep)
 
 
@@ -229,6 +235,9 @@ def restore_for_reform() -> int:
             ctx.warm_plans = entry["plans"]
         else:
             _warm_plans = entry["plans"]
+        _conformance.record(
+            "ops/dispatch_cache.py::restore_for_reform", "restore",
+            (shape, len(entry["plans"])))
         return len(entry["plans"])
 
 
@@ -253,6 +262,9 @@ def _warm_graft_locked(ctx, key: tuple, plan) -> None:
     plan.execute = warm.execute
     _metrics.ELASTIC_WARM_REUSE.inc(labels={
         "kind": "step" if plan.variant == "step" else "plan"})
+    _conformance.record(
+        "ops/dispatch_cache.py::_warm_graft_locked", "graft",
+        (plan.variant, _zlib.crc32(repr(key).encode()) & 0xFFFFFFFF))
 
 
 def _ctx_store():
@@ -466,6 +478,14 @@ def store(key: tuple, plan: DispatchPlan) -> None:
         while len(plans) > cap:
             plans.popitem(last=False)
             _metrics.DISPATCH_EVICTIONS.inc()
+    # Local event (docs/conformance.md): plan-key builds are
+    # legitimately rank-asymmetric after a warm re-form (a survivor
+    # hits where a fresh rank builds), so they are recorded per rank
+    # but never chained cross-rank.
+    _conformance.record(
+        "ops/dispatch_cache.py::store", "plan_store",
+        (getattr(plan, "variant", "unplannable"),
+         _zlib.crc32(repr(key).encode()) & 0xFFFFFFFF))
     if plan is not UNPLANNABLE:
         _timeline.record_dispatch(plan.label, hit=False)
 

@@ -110,6 +110,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import autotune as _autotune
+from .. import conformance as _conformance
 from .. import metrics as _metrics
 from .. import qos as _qos
 from .. import timeline as _timeline
@@ -592,8 +593,15 @@ class FusionScheduler:
             self._stats["flushes"][trigger] += 1
             self._stats["flushed_tensors"] += sum(e.count for e in entries)
             self._stats["flushed_bytes"] += q.nbytes
-            self.flush_history.append(
-                (trigger, key, tuple(n for e in entries for n in e.names)))
+            names = tuple(n for e in entries for n in e.names)
+            self.flush_history.append((trigger, key, names))
+            # Lockstep decision point (docs/conformance.md): the flush
+            # composition every rank must derive identically. The
+            # trigger is deliberately NOT hashed — WHEN a queue drains
+            # may vary across ranks (timer jitter); WHAT drains may not.
+            _conformance.record(
+                "ops/fusion_cycle.py::FusionScheduler.flush_queue",
+                "flush", (q.spec.kind, names))
             self._inflight_until = _inv.monotonic() + (
                 _INFLIGHT_WINDOW_CYCLES * envs.cycle_time_ms() / 1e3)
             if pipelined:

@@ -60,11 +60,14 @@ honest when capture is on.
 
 from __future__ import annotations
 
+import zlib as _zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import autotune as _autotune
+from .. import conformance as _conformance
 from .. import metrics as _metrics
 from .. import timeline as _timeline
 from ..utils import envs
@@ -499,6 +502,7 @@ class CaptureState:
         if not self.enabled() and self._state == "idle" \
                 and not self._region_open:
             return
+        prev_state = self._state
         fallback = None
         with self._mu:
             if self._state == "record":
@@ -524,6 +528,11 @@ class CaptureState:
         if fallback:
             self._run_fallback(fallback)
         if closing or not self.enabled():
+            # Lockstep decision point (docs/conformance.md): every rank
+            # must close the region from the same phase.
+            _conformance.record(
+                "ops/step_capture.py::CaptureState.boundary", "phase",
+                (prev_state, "idle"))
             return
         with self._mu:
             self._region_open = True
@@ -549,6 +558,11 @@ class CaptureState:
                 # would only burn bookkeeping — stay eager for the region
                 self._state = "bypass"
         _note_capture(state=self._state)
+        # Lockstep decision point (docs/conformance.md): the boundary's
+        # phase move — seal/arm/record/bypass — is rank-deterministic.
+        _conformance.record(
+            "ops/step_capture.py::CaptureState.boundary", "phase",
+            (prev_state, self._state))
         _timeline.record_capture(
             "REPLAY" if self._replaying
             else ("RECORD" if self._recording else "BYPASS"))
@@ -562,6 +576,12 @@ class CaptureState:
         self._stats["captured_flushes"] += len(records)
         _note_capture("recorded")
         key = tuple(r.signature() for r in records)
+        # Lockstep decision point (docs/conformance.md): the sealed
+        # stream key every rank must derive byte-identically (hashed —
+        # full signatures are long; the ring keeps the quotable form).
+        _conformance.record(
+            "ops/step_capture.py::CaptureState._seal_locked", "seal",
+            (len(records), _zlib.crc32(repr(key).encode()) & 0xFFFFFFFF))
         cached = _dispatch.lookup(_store_key(key), record_stats=False)
         if isinstance(cached, StepPlan):
             self._last_key = key  # alternating streams reuse their plan
@@ -708,6 +728,12 @@ class CaptureState:
         return groups
 
     def _diverge_locked(self) -> None:
+        # Lockstep decision point (docs/conformance.md): a divergence
+        # fallback is itself rank-deterministic — the stream mismatched
+        # identically everywhere (a rank-local fallback IS a finding).
+        _conformance.record(
+            "ops/step_capture.py::CaptureState._diverge_locked", "phase",
+            (self._state, "bypass"))
         self._stats["fallbacks"] += 1
         self._stats["invalidations"] += 1
         _note_capture("fallback", state="bypass")
@@ -791,6 +817,11 @@ class CaptureState:
         with self._mu:
             self._stats["replayed_steps"] += 1
             self._stats["replayed_entries"] += len(entries)
+        # Lockstep decision point (docs/conformance.md): the replayed
+        # whole-step program executed — same record count everywhere.
+        _conformance.record(
+            "ops/step_capture.py::CaptureState._execute_replay",
+            "replayed", (len(groups),))
         _note_capture("replayed", state="replayed")
         _timeline.record_capture("REPLAY_DONE")
 

@@ -68,6 +68,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 
+from . import conformance as _conformance
 from . import metrics as _metrics
 from . import timeline as _timeline
 from .exceptions import QosAdmissionError
@@ -515,6 +516,11 @@ class QosGate:
             series["share"].set(
                 ts["granted_bytes"] / self._total_granted_bytes)
         self.grant_history.append((rec.tenant, rec.seq))
+        # Lockstep decision point (docs/conformance.md): the arbiter's
+        # grant order — tenant, per-tenant submission seq, and whether
+        # the starvation valve forced it — must be identical rank-wise.
+        _conformance.record("qos.py::QosGate._grant_locked", "grant",
+                            (rec.tenant, rec.seq, bool(forced)))
         _timeline.record_qos("FORCE" if forced else "GRANT", rec.tenant)
         self._emit(rec.batch)
 

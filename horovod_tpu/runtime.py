@@ -91,6 +91,11 @@ def init(
       devices: explicit device list (testing hook).
       axis_name: mesh axis name used by every collective.
     """
+    from . import conformance as _conformance
+    # the lockstep recorder's cached gate re-reads HVD_CONFORMANCE at
+    # init so launcher-seeded (or test-set) knobs engage without an
+    # import-order dance (docs/conformance.md)
+    _conformance.refresh()
     ctx = _lbctx.current()
     if ctx is not None:
         _loopback_init(ctx, axis_name=axis_name, process_sets=process_sets)
@@ -460,6 +465,7 @@ def shutdown() -> None:
         return
     global _state, _bootstrap_kv_server, _bootstrap_seeded_env
     from . import autotune as _autotune
+    from . import conformance as _conformance
     from . import engine_service as _engine_service
     from .ops import dispatch_cache as _dispatch_cache
     from .ops import fusion_cycle as _fusion_cycle
@@ -476,6 +482,11 @@ def shutdown() -> None:
     # Plans hold compiled programs over this world's meshes; none survive
     # a shutdown (the generation epoch also guards re-init races).
     _dispatch_cache.invalidate("runtime shutdown")
+    # Conformance trace out LAST — the teardown above records events
+    # too (service stop, plan shelving); the recorder then resets so a
+    # later init() starts a fresh trace incarnation.
+    _conformance.maybe_dump("shutdown")
+    _conformance.reset()
     if _bootstrap_kv_server is not None:
         try:
             _bootstrap_kv_server.stop()
@@ -500,6 +511,7 @@ def _loopback_shutdown(ctx) -> None:
     untouched."""
     if ctx.runtime_state is None:
         return
+    from . import conformance as _conformance
     from . import engine_service as _engine_service
     from .ops import dispatch_cache as _dispatch_cache
     from .ops import fusion_cycle as _fusion_cycle
@@ -521,6 +533,12 @@ def _loopback_shutdown(ctx) -> None:
     sched, ctx.scheduler = ctx.scheduler, None
     if sched is not None:
         sched.stop()
+    # Per-rank conformance trace out LAST — the teardown above records
+    # events too (plan shelving, service stop); reset so an elastic
+    # re-init in the SAME context starts a fresh trace (the generation
+    # in the file name keeps incarnations apart).
+    _conformance.maybe_dump("shutdown")
+    _conformance.reset()
     # NOTE: ctx.notification_manager deliberately survives — an elastic
     # re-init calls this mid-run and the manager's listeners must carry
     # into the next round (real elastic parity); the worker wrapper and

@@ -223,9 +223,9 @@ def record(tensor: str, activity: str, phase: int) -> None:
         return
     eng = _engine
     if eng is not None:
-        ctx = _lbctx.current()
-        if ctx is not None:
-            tensor = f"rank{ctx.rank}/{tensor}"
+        label = _lbctx.current_rank_label()
+        if label:
+            tensor = f"{label}/{tensor}"
         eng.timeline_record(tensor, activity, phase)
 
 

@@ -102,6 +102,9 @@ QOS_DEFAULT_WEIGHT = "QOS_DEFAULT_WEIGHT"  # DRR weight for unconfigured tenants
 QOS_PENDING_QUOTA = "QOS_PENDING_QUOTA"  # default per-tenant pending-bytes quota (0 = unlimited)
 QOS_SHED_POLICY = "QOS_SHED_POLICY"  # quota policy for unconfigured tenants: block | shed
 QOS_CLASSES = "QOS_CLASSES"  # per-tenant class spec string (docs/qos.md grammar)
+CONFORMANCE = "CONFORMANCE"  # cross-rank lockstep conformance recorder (0 = off)
+CONFORMANCE_DIR = "CONFORMANCE_DIR"  # per-rank trace dump directory (empty = dump on demand only)
+CONFORMANCE_RING = "CONFORMANCE_RING"  # full-payload ring capacity per rank recorder
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -426,6 +429,33 @@ DEFAULT_QOS_WINDOW = 4
 DEFAULT_QOS_QUANTUM = 64 * 1024
 DEFAULT_QOS_STARVE_LIMIT = 16
 DEFAULT_QOS_WEIGHT = 1.0
+
+
+# Conformance recorder defaults (horovod_tpu/conformance.py,
+# docs/conformance.md). The 256-event payload ring bounds per-rank
+# memory while keeping the recent window a divergence report needs —
+# the compact per-event digest chain localizes ANY event; the ring only
+# decides whether its full payload is still quotable.
+DEFAULT_CONFORMANCE_RING = 256
+
+
+def conformance_enabled() -> bool:
+    """Cross-rank lockstep conformance recorder
+    (``horovod_tpu/conformance.py``): off by default — every decision
+    point's hook is then one cached module-bool check and an early
+    return (the ``utils/faults.py`` fast-path idiom)."""
+    return get_bool(CONFORMANCE, False)
+
+
+def conformance_dir() -> str:
+    """``HVD_CONFORMANCE_DIR``: directory for per-rank trace dumps at
+    shutdown/abort. Empty (default) = traces stay in memory and are
+    only materialized by an explicit ``hvd.conformance_dump()``."""
+    return (get(CONFORMANCE_DIR, "") or "").strip()
+
+
+def conformance_ring() -> int:
+    return max(0, get_int(CONFORMANCE_RING, DEFAULT_CONFORMANCE_RING))
 
 
 def qos_enabled() -> bool:

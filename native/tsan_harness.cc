@@ -1,0 +1,214 @@
+/* ThreadSanitizer harness for the native dynamic engine.
+ *
+ * hvdsched model-checks the *Python* concurrency core on a cooperative
+ * seam; the native engine's real pthreads (the timeline writer thread,
+ * plus whatever threads the embedder drives the C API from) are outside
+ * that seam. This harness drives the documented concurrency contract of
+ * hvd_core.h hard from real threads so `ci.sh` can run it under
+ * -fsanitize=thread: any data race in engine.cc/timeline.cc is a CI
+ * failure, not a once-a-month loopback heisencrash.
+ *
+ * Thread roles mirror the Python embedding (one world of 2 ranks as two
+ * engines in-process, the loopback shape):
+ *   - N submitter threads: enqueue/abandon named tensors on BOTH rank
+ *     engines (rank-symmetric, so negotiation completes);
+ *   - 1 negotiator thread: the per-cycle pop -> rank-ordered ingest ->
+ *     compute_responses -> cache-bits AND -> commit loop. It is the only
+ *     thread touching the pop/resp/bits out-buffer slots, per the
+ *     header's "valid until the next call on the same engine from the
+ *     same thread" ownership rule;
+ *   - 1 watchdog thread: stall_report + introspection (its out-buffer
+ *     slot is its own);
+ *   - M recorder threads: hammer hvd_timeline_record while the main
+ *     thread cycles hvd_timeline_start/stop underneath them.
+ *
+ * Also asserts the symmetric-negotiation invariant while it runs: both
+ * engines must compute byte-identical response lists every cycle.
+ */
+
+#include <atomic>
+#include <cassert>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hvd_core.h"
+
+namespace {
+
+constexpr int kWorld = 2;
+constexpr int kSubmitters = 2;
+constexpr int kRecorders = 2;
+constexpr int kItersPerSubmitter = 120;
+
+hvd_engine_t g_engine[kWorld];
+std::atomic<int> g_submitters_done{0};
+std::atomic<bool> g_stop_aux{false};
+std::atomic<long> g_cycles{0};
+std::atomic<long> g_responses_checked{0};
+std::atomic<long> g_records{0};
+
+void submitter(int sid) {
+  int64_t shape[1] = {16};
+  for (int i = 0; i < kItersPerSubmitter; ++i) {
+    std::string name = "g" + std::to_string(sid) + "_" + std::to_string(i);
+    int type = (i % 5 == 0) ? HVD_REQ_BROADCAST : HVD_REQ_ALLREDUCE;
+    for (int r = 0; r < kWorld; ++r) {
+      int32_t rc = hvd_engine_enqueue(
+          g_engine[r], name.c_str(), type, /*dtype=*/0, /*element_size=*/4,
+          shape, /*ndim=*/1, /*root_rank=*/0, /*group_id=*/-1,
+          /*splits=*/nullptr, /*nsplits=*/0, /*reduce_op=*/0,
+          /*prescale=*/1.0, /*postscale=*/1.0, /*splits_crc=*/0);
+      assert(rc >= -2);
+      (void)rc;
+    }
+    if (i % 7 == 3) {
+      // symmetric retry-after-timeout shape: both ranks abandon, so the
+      // name either never went out (cleanly dropped) or completes as a
+      // normal table entry; rc -1 (already completed) is fine
+      for (int r = 0; r < kWorld; ++r) {
+        hvd_engine_abandon(g_engine[r], name.c_str());
+      }
+    }
+    hvd_timeline_record(g_engine[0], name.c_str(), "ENQUEUE", 2, -1);
+    if (i % 16 == 0) std::this_thread::yield();
+  }
+  g_submitters_done.fetch_add(1);
+}
+
+void negotiator() {
+  std::vector<uint8_t> pops[kWorld];
+  std::vector<uint8_t> resp0;
+  for (;;) {
+    bool drained = g_submitters_done.load() == kSubmitters;
+    // pop every rank first (copy out: ingest on the same engine re-enters
+    // the lock and the next pop invalidates the slot)
+    for (int r = 0; r < kWorld; ++r) {
+      const uint8_t* buf = nullptr;
+      size_t len = 0;
+      hvd_engine_pop_requests(g_engine[r], &buf, &len);
+      pops[r].assign(buf, buf + len);
+    }
+    for (int r = 0; r < kWorld; ++r) {
+      for (int src = 0; src < kWorld; ++src) {
+        hvd_engine_ingest(g_engine[r], src, pops[src].data(),
+                          pops[src].size());
+      }
+    }
+    for (int r = 0; r < kWorld; ++r) {
+      const uint8_t* buf = nullptr;
+      size_t len = 0;
+      hvd_engine_compute_responses(g_engine[r], &buf, &len);
+      if (r == 0) {
+        resp0.assign(buf, buf + len);
+      } else {
+        // symmetric negotiation: identical inputs in rank order must
+        // yield byte-identical plans on every member
+        assert(len == resp0.size() &&
+               std::memcmp(buf, resp0.data(), len) == 0);
+        g_responses_checked.fetch_add(1);
+      }
+    }
+    // response-cache coordination round: AND the bit vectors, commit
+    const uint8_t* bits[kWorld];
+    size_t blen[kWorld];
+    std::vector<uint8_t> anded;
+    for (int r = 0; r < kWorld; ++r) {
+      hvd_engine_cache_bits(g_engine[r], &bits[r], &blen[r]);
+    }
+    size_t n = blen[0] < blen[1] ? blen[0] : blen[1];
+    anded.resize(n);
+    for (size_t i = 0; i < n; ++i) anded[i] = bits[0][i] & bits[1][i];
+    for (int r = 0; r < kWorld; ++r) {
+      hvd_engine_commit_cache_bits(g_engine[r], anded.data(), anded.size());
+    }
+    long c = g_cycles.fetch_add(1) + 1;
+    if (drained && hvd_engine_pending_count(g_engine[0]) == 0 &&
+        hvd_engine_pending_count(g_engine[1]) == 0) {
+      return;
+    }
+    if (c > 200000) {
+      std::fprintf(stderr, "tsan harness: negotiation never drained\n");
+      std::abort();
+    }
+    if (c % 64 == 0) std::this_thread::yield();
+  }
+}
+
+void watchdog() {
+  while (!g_stop_aux.load()) {
+    for (int r = 0; r < kWorld; ++r) {
+      const uint8_t* buf = nullptr;
+      size_t len = 0;
+      hvd_engine_stall_report(g_engine[r], &buf, &len);
+      hvd_engine_pending_count(g_engine[r]);
+      hvd_engine_cache_size(g_engine[r]);
+      hvd_engine_cache_has(g_engine[r], "g0_0");
+      hvd_engine_join_pending(g_engine[r]);
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+void recorder(int rid) {
+  int i = 0;
+  while (!g_stop_aux.load()) {
+    std::string tensor = "lane" + std::to_string(rid);
+    // engine 1's timeline is never started: records there must be cheap
+    // inactive no-ops, and racing them against start/stop is the point
+    hvd_timeline_record(g_engine[0], tensor.c_str(), "CYCLE", 0, -1);
+    hvd_timeline_record(g_engine[0], tensor.c_str(), "CYCLE", 1, -1);
+    hvd_timeline_record(g_engine[1], tensor.c_str(), "IDLE", 2, -1);
+    g_records.fetch_add(3);
+    if (++i % 32 == 0) std::this_thread::yield();
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* tl_path =
+      argc > 1 ? argv[1] : "/tmp/hvd_tsan_timeline.json";
+  for (int r = 0; r < kWorld; ++r) {
+    g_engine[r] = hvd_engine_create(kWorld, r, /*fusion_threshold=*/1 << 20,
+                                    /*cache_capacity=*/64,
+                                    /*stall_warn=*/0.05,
+                                    /*stall_shutdown=*/0.0);
+    assert(g_engine[r] != nullptr);
+  }
+  assert(hvd_timeline_start(g_engine[0], tl_path) == 0);
+
+  std::vector<std::thread> aux;
+  aux.emplace_back(watchdog);
+  for (int i = 0; i < kRecorders; ++i) aux.emplace_back(recorder, i);
+  std::vector<std::thread> subs;
+  for (int i = 0; i < kSubmitters; ++i) subs.emplace_back(submitter, i);
+  std::thread neg(negotiator);
+
+  // cycle the timeline under live recorders: stop/start is the race the
+  // writer thread's shutdown handshake must survive
+  for (int i = 0; i < 3; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    hvd_timeline_stop(g_engine[0]);
+    assert(hvd_timeline_start(g_engine[0], tl_path) == 0);
+  }
+
+  for (auto& t : subs) t.join();
+  neg.join();
+  g_stop_aux.store(true);
+  for (auto& t : aux) t.join();
+  hvd_timeline_stop(g_engine[0]);
+  for (int r = 0; r < kWorld; ++r) hvd_engine_destroy(g_engine[r]);
+
+  std::printf(
+      "tsan harness OK: %ld cycles, %ld identical cross-rank response "
+      "lists, %ld timeline records, %d tensors/submitter x %d "
+      "submitters (engine %s)\n",
+      g_cycles.load(), g_responses_checked.load(), g_records.load(),
+      kItersPerSubmitter, kSubmitters, hvd_core_version());
+  return 0;
+}

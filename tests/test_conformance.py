@@ -1,0 +1,581 @@
+"""Lockstep conformance instrument (docs/conformance.md).
+
+Covers both halves end to end: recorder determinism and the dump API
+(``horovod_tpu/conformance.py``), the clean cross-rank diff at world=8,
+the world=16 composite run (hierarchy auto-engaged + response cache +
+QoS + step capture) diffing clean, BOTH planted divergence demos found
+and localized to the first divergent event with site + rank pair, the
+hvdtrace binary-search localization and digest fast path on synthetic
+traces, and the protocol FSM fixtures.
+
+The planted demos deadlock for REAL — a divergent flush composition is
+a negotiation that never completes — so they run bounded
+(``HVD_ELASTIC_TIMEOUT=8`` + stall checker off + ``allow_failures``):
+every rank fails with the collective error in seconds and the abort
+path still dumps each rank's trace, which is exactly the production
+flow the instrument exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import _native
+from horovod_tpu import conformance
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools import hvdtrace  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native engine unavailable")
+
+FLUSH_SITE = "ops/fusion_cycle.py::FusionScheduler.flush_queue"
+
+# pinned cycle knobs: every flush comes from an explicit cut, the
+# comparability precondition (docs/conformance.md "What the flush hash
+# covers")
+PINNED = {"HVD_CYCLE_TIME": "500", "HVD_PENDING_CYCLE_TIME": "500"}
+
+# a planted divergence hangs negotiation until the exchange deadline;
+# bound it so the demo fails (and dumps) in seconds instead of 600 s
+DEMO_BOUND = {"HVD_ELASTIC_TIMEOUT": "8", "HVD_STALL_CHECK_DISABLE": "1"}
+
+
+@pytest.fixture(autouse=True)
+def _restore_gate():
+    """Worlds enable the process-global gate via their env overlays;
+    re-read it from the (unset) main-thread env afterwards so recording
+    never leaks into unrelated tests."""
+    yield
+    conformance.set_enabled(None)
+    conformance.refresh()
+    conformance.reset()
+
+
+# ---------------------------------------------------------------------------
+# recorder unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestRecorderDeterminism:
+    EVENTS = [
+        (FLUSH_SITE, "flush", ("allreduce", ("g0", "g1", "g2"))),
+        ("qos.py::QosGate._grant_locked", "grant", ("serve", 1, False)),
+        (FLUSH_SITE, "flush", ("allgather", ("h0",))),
+        ("negotiation/response_cache.py::ResponseCache.note_response",
+         "confirm", ("global", "g0")),
+    ]
+
+    def test_identical_streams_make_identical_chains(self):
+        a, b = conformance.Recorder(), conformance.Recorder()
+        for site, kind, payload in self.EVENTS * 5:
+            a.note(site, kind, payload)
+            b.note(site, kind, payload)
+        assert a.chains == b.chains
+        assert [e[5] for e in a.events] == [e[5] for e in b.events]
+        assert a.chains["flush"] != 0 and a.chains["qos"] != 0
+
+    def test_one_payload_difference_diverges_the_stream_chain(self):
+        a, b = conformance.Recorder(), conformance.Recorder()
+        for site, kind, payload in self.EVENTS:
+            a.note(site, kind, payload)
+            b.note(site, kind, payload)
+        a.note(FLUSH_SITE, "flush", ("allreduce", ("x0", "x1")))
+        b.note(FLUSH_SITE, "flush", ("allreduce", ("x0",)))
+        assert a.chains["flush"] != b.chains["flush"]
+        # the other streams are untouched: streams isolate divergence
+        assert a.chains["qos"] == b.chains["qos"]
+        assert a.chains["rcache"] == b.chains["rcache"]
+
+    def test_local_events_never_chain(self):
+        rec = conformance.Recorder()
+        rec.note("ops/dispatch_cache.py::store", "plan_store",
+                 ("eager", 12345))
+        rec.note("engine_service.py::DynamicService.__init__",
+                 "svc_start", ("global", 4, 0))
+        assert rec.chains["plans"] == 0
+        assert rec.chains["service"] == 0
+        # but the events carry their own content crc and land in the ring
+        assert all(e[5] != 0 for e in rec.events)
+        assert len(rec.ring) == 2
+
+    def test_ring_is_bounded_events_are_not(self, monkeypatch):
+        monkeypatch.setenv("HVD_CONFORMANCE_RING", "4")
+        rec = conformance.Recorder()
+        for i in range(10):
+            rec.note(FLUSH_SITE, "flush", ("allreduce", (f"t{i}",)))
+        assert len(rec.events) == 10
+        assert len(rec.ring) == 4
+        assert rec.ring[0][0] == 6  # oldest retained seq: truncation marker
+
+    def test_disabled_record_is_a_noop(self):
+        conformance.reset()
+        conformance.set_enabled(False)
+        conformance.record(FLUSH_SITE, "flush", ("allreduce", ("a",)))
+        assert conformance.conformance_stats()["events"] == 0
+
+    def test_dump_roundtrips_through_json(self, tmp_path):
+        conformance.reset()
+        conformance.set_enabled(True)
+        conformance.record(FLUSH_SITE, "flush", ("allreduce", ("a", "b")))
+        target = tmp_path / "trace.json"
+        doc = conformance.conformance_dump(str(target))
+        loaded = json.loads(target.read_text())
+        assert loaded["schema"] == conformance.TRACE_SCHEMA
+        assert loaded["chains"] == doc["chains"]
+        assert any(e[3] == FLUSH_SITE for e in loaded["events"])
+        # no dir knob + no explicit path -> snapshot only, no write
+        assert "path" not in conformance.conformance_dump()
+
+
+# ---------------------------------------------------------------------------
+# differ unit behavior (synthetic traces; no world)
+# ---------------------------------------------------------------------------
+
+
+def _rank_doc(rank: int, feed) -> dict:
+    """A trace document from a real Recorder fed ``feed``, re-labeled as
+    ``rank``."""
+    rec = conformance.Recorder()
+    for site, kind, payload in feed:
+        rec.note(site, kind, payload)
+    doc = rec.trace()
+    doc.update({"label": f"rank{rank}", "rank": rank, "size": 2,
+                "world": "synth", "round": "1"})
+    return doc
+
+
+def _write_docs(tmp_path, docs):
+    for doc in docs:
+        name = f"hvdtrace-synth-r1-g0-rank{doc['rank']}.json"
+        (tmp_path / name).write_text(json.dumps(doc))
+
+
+class TestDifferLocalization:
+    def test_digest_fast_path_identical_traces_clean(self, tmp_path):
+        feed = [(FLUSH_SITE, "flush", ("allreduce", (f"t{i}",)))
+                for i in range(8)]
+        _write_docs(tmp_path, [_rank_doc(0, feed), _rank_doc(1, feed)])
+        findings, errors, summary = hvdtrace.run_check([str(tmp_path)])
+        assert findings == [] and errors == []
+        assert summary["traces"] == 2 and summary["divergences"] == 0
+
+    def test_binary_search_finds_first_divergent_index(self, tmp_path):
+        common = [(FLUSH_SITE, "flush", ("allreduce", (f"t{i}",)))
+                  for i in range(11)]
+        a = common + [(FLUSH_SITE, "flush", ("allreduce", ("same",)))] * 9
+        b = (common
+             + [(FLUSH_SITE, "flush", ("allreduce", ("DIVERGED",)))]
+             + [(FLUSH_SITE, "flush", ("allreduce", ("same",)))] * 8)
+        _write_docs(tmp_path, [_rank_doc(0, a), _rank_doc(1, b)])
+        findings, _errors, summary = hvdtrace.run_check([str(tmp_path)])
+        divs = [f for f in findings if f["type"] == "divergence"]
+        assert len(divs) == 1 and summary["divergences"] == 1
+        f0 = divs[0]
+        # the FIRST divergent event, not just "the streams differ":
+        # index 11 is the mid-stream cut, with both payloads quoted
+        assert f0["stream"] == "flush" and f0["index"] == 11
+        assert f0["rank_a"] == "rank0" and f0["rank_b"] == "rank1"
+        assert f0["a"]["site"] == FLUSH_SITE
+        assert "same" in f0["a"]["payload"]
+        assert "DIVERGED" in f0["b"]["payload"]
+        # the report names site, rank pair, and both payloads
+        text = hvdtrace.format_finding(f0)
+        assert "DIVERGENCE" in text and FLUSH_SITE in text
+        assert "rank0" in text and "rank1" in text
+
+    def test_length_skew_localizes_past_shared_prefix(self, tmp_path):
+        common = [(FLUSH_SITE, "flush", ("allreduce", (f"t{i}",)))
+                  for i in range(5)]
+        _write_docs(tmp_path, [_rank_doc(0, common),
+                               _rank_doc(1, common[:3])])
+        findings, _errors, _summary = hvdtrace.run_check([str(tmp_path)])
+        divs = [f for f in findings if f["type"] == "divergence"]
+        assert len(divs) == 1
+        assert divs[0]["index"] == 3  # shared prefix matched in full
+        assert divs[0]["a"] is not None and divs[0]["b"] is None
+
+    def test_missing_rank_is_an_incomplete_group(self, tmp_path):
+        feed = [(FLUSH_SITE, "flush", ("allreduce", ("t",)))]
+        doc = _rank_doc(0, feed)
+        doc["size"] = 4
+        _write_docs(tmp_path, [doc])
+        findings, _errors, summary = hvdtrace.run_check([str(tmp_path)])
+        assert summary["incomplete_groups"] == 1
+        assert findings[0]["type"] == "missing-ranks"
+        assert findings[0]["missing"] == 3
+
+    def test_cli_json_exit_codes(self, tmp_path):
+        clean, bad = tmp_path / "clean", tmp_path / "bad"
+        clean.mkdir(), bad.mkdir()
+        feed = [(FLUSH_SITE, "flush", ("allreduce", ("t",)))]
+        _write_docs(clean, [_rank_doc(0, feed), _rank_doc(1, feed)])
+        _write_docs(bad, [
+            _rank_doc(0, feed),
+            _rank_doc(1, [(FLUSH_SITE, "flush", ("allreduce", ("x",)))])])
+
+        def cli(*args):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (str(REPO_ROOT) + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            return subprocess.run(
+                [sys.executable, "-m", "tools.hvdtrace", *args],
+                capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+                timeout=60)
+
+        ok = cli(str(clean), "--json")
+        assert ok.returncode == 0, ok.stderr
+        assert json.loads(ok.stdout)["clean"] is True
+        div = cli(str(bad), "--json")
+        assert div.returncode == 1, div.stderr
+        report = json.loads(div.stdout)
+        assert report["summary"]["divergences"] == 1
+        empty = cli(str(tmp_path / "nowhere"))
+        assert empty.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# protocol FSM fixtures
+# ---------------------------------------------------------------------------
+
+
+def _fsm_doc(ring) -> dict:
+    rows = [[seq, site, kind, repr(payload)]
+            for seq, (site, kind, payload) in enumerate(ring)]
+    return {"schema": 1, "label": "rank0", "rank": 0, "size": 1,
+            "world": "fsm", "round": "0", "generation": 0,
+            "chains": {}, "events": [], "ring": rows,
+            "n_events": len(rows)}
+
+
+CAP = "ops/step_capture.py::CaptureState"
+RC = "negotiation/response_cache.py::ResponseCache"
+SVC = "engine_service.py::DynamicService"
+EPOCH = "conformance.py::Recorder.note"
+
+
+class TestProtocolFsm:
+    def _rules(self, ring):
+        return [f["rule"] for f in hvdtrace.validate_fsm(_fsm_doc(ring))]
+
+    def test_seal_outside_record_is_illegal(self):
+        ring = [(f"{CAP}.boundary", "phase", ("idle", "replay")),
+                (f"{CAP}._seal_locked", "seal", (3, 123))]
+        assert self._rules(ring) == ["capture-seal"]
+        ring = [(f"{CAP}.boundary", "phase", ("idle", "record")),
+                (f"{CAP}._seal_locked", "seal", (3, 123))]
+        assert self._rules(ring) == []
+
+    def test_explicit_transition_into_replayed_is_illegal(self):
+        ring = [(f"{CAP}.boundary", "phase", ("replay", "replayed"))]
+        assert self._rules(ring) == ["capture-phase"]
+
+    def test_phase_from_must_chain(self):
+        ring = [(f"{CAP}.boundary", "phase", ("idle", "record")),
+                (f"{CAP}.boundary", "phase", ("replay", "idle"))]
+        assert self._rules(ring) == ["capture-phase"]
+
+    def test_replay_completion_only_from_replay(self):
+        ring = [(f"{CAP}.boundary", "phase", ("idle", "record")),
+                (f"{CAP}._execute_replay", "replayed", (4,))]
+        assert self._rules(ring) == ["capture-replay"]
+        ring = [(f"{CAP}.boundary", "phase", ("idle", "replay")),
+                (f"{CAP}._execute_replay", "replayed", (4,))]
+        assert self._rules(ring) == []
+
+    def test_warm_confirm_needs_nonempty_restore(self):
+        ring = [(f"{RC}.confirm_warm", "warm_confirm", ("global", 3))]
+        assert self._rules(ring) == ["warm-order"]
+        ring = [(f"{RC}.restore_warm", "warm_restore", ("global", 5)),
+                (f"{RC}.confirm_warm", "warm_confirm", ("global", 3))]
+        assert self._rules(ring) == []
+        # empty confirms are legal anytime (drop_warm fires at n==0 too)
+        ring = [(f"{RC}.confirm_warm", "warm_confirm", ("global", 0)),
+                (f"{RC}.drop_warm", "warm_drop", ("global", 0))]
+        assert self._rules(ring) == []
+
+    def test_served_after_join_is_illegal(self):
+        ring = [(f"{SVC}.__init__", "svc_start", ("global", 2, 0)),
+                (f"{SVC}.join", "join", ("global", "jn")),
+                (f"{RC}.count_served", "served", ("global", 2, 1))]
+        assert self._rules(ring) == ["served-after-join"]
+
+    def test_join_after_abort_is_illegal(self):
+        ring = [(f"{SVC}.__init__", "svc_start", ("global", 2, 0)),
+                (f"{SVC}._on_peer_failure", "svc_abort", ("global", 1)),
+                (f"{SVC}.join", "join", ("global", "jn"))]
+        assert self._rules(ring) == ["service-lifecycle"]
+
+    def test_service_events_need_svc_start_unless_truncated(self):
+        ring = [(f"{SVC}.stop", "svc_stop", ("global",))]
+        assert self._rules(ring) == ["service-lifecycle"]
+        # a ring that no longer covers the trace head suppresses
+        # "must be preceded by" rules for the unseen prefix
+        doc = _fsm_doc(ring)
+        doc["ring"][0][0] = 7  # first retained seq > 0: truncated
+        assert hvdtrace.validate_fsm(doc) == []
+
+    def test_epoch_moves_chain_and_stay_monotone(self):
+        ring = [(EPOCH, "epoch", (0, 1)), (EPOCH, "epoch", (5, 7))]
+        assert self._rules(ring) == ["epoch-chain"]
+        ring = [(EPOCH, "epoch", (3, 2))]
+        assert self._rules(ring) == ["epoch-chain"]
+        ring = [(EPOCH, "epoch", (0, 1)), (EPOCH, "epoch", (1, 4))]
+        assert self._rules(ring) == []
+
+
+# ---------------------------------------------------------------------------
+# clean worlds diff clean
+# ---------------------------------------------------------------------------
+
+
+class TestCleanWorldDiff:
+    def test_world8_clean_cross_rank_diff(self, tmp_path):
+        extra = {**PINNED, "HVD_CONFORMANCE": "1",
+                 "HVD_CONFORMANCE_DIR": str(tmp_path)}
+        with hvd.loopback.world(8, extra_env=extra) as w:
+            def body():
+                r = hvd.rank()
+                for i in range(3):
+                    out = hvd.allreduce(jnp.full((4,), float(r + i)),
+                                        op=hvd.Sum, name=f"e{i}")
+                    np.asarray(out)
+                hs = [hvd.allreduce_async(jnp.full((8,), float(r + i)),
+                                          op=hvd.Sum, name=f"a{i}")
+                      for i in range(6)]
+                hvd.fusion_flush()
+                vals = [np.asarray(h.result()) for h in hs]
+                assert all(v.shape == (8,) for v in vals)
+                return "OK"
+
+            outs = w.run(body, timeout=240)
+            assert [o.result for o in outs] == ["OK"] * 8
+
+        findings, errors, summary = hvdtrace.run_check([str(tmp_path)])
+        assert errors == []
+        assert summary["traces"] == 8
+        assert len(summary["groups"]) == 1
+        assert summary["groups"][0]["ranks"] == [f"rank{r}"
+                                                 for r in range(8)]
+        assert findings == [], [hvdtrace.format_finding(f)
+                                for f in findings]
+
+
+_COMPOSITE_SCRIPT = r"""
+import os
+import threading
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.utils import envs
+
+N = 16
+# HVD_QOS deliberately NOT seeded: the runtime keeps step capture and
+# QoS mutually exclusive (envs.step_capture_enabled), so the composite
+# phases them — capture segment first, then a mid-run knob override
+# turns QoS on, which also exercises the override-epoch stream
+extra = {
+    "HVD_CONFORMANCE": "1",
+    "HVD_CONFORMANCE_DIR": os.environ["CONF_DIR"],
+    "HVD_CYCLE_TIME": "500",
+    "HVD_PENDING_CYCLE_TIME": "500",
+    "HVD_RESPONSE_CACHE": "1",
+    "HVD_STEP_CAPTURE": "1",
+}
+
+_flip_mu = threading.Lock()
+
+def flip_qos_on():
+    # serialized across rank threads: set_override's no-op guard is
+    # check-then-act, and 16 racing callers would bump the epoch twice
+    # (ranks would then disagree on the (old, new) moves they record)
+    with _flip_mu:
+        envs.set_override(envs.QOS, "1")
+
+with hvd.loopback.world(N, extra_env=extra) as w:
+    def body():
+        r = hvd.rank()
+        # capture segment: one recorded step, two replayed
+        for step in range(3):
+            hvd.step_marker()
+            hs = [hvd.allreduce_async(
+                      jnp.full((4,), float(r + i + step)), op=hvd.Sum,
+                      name=f"t{i}") for i in range(3)]
+            [np.asarray(h.result()) for h in hs]
+        hvd.step_marker()
+        # rendezvous AFTER the final marker: its completed result means
+        # every rank has passed its last capture boundary, so the flip
+        # below cannot race a straggler's enabled() read mid-boundary
+        # (the boundary's phase move depends on the live QoS knob)
+        np.asarray(hvd.allreduce(jnp.full((2,), float(r)), op=hvd.Sum,
+                                 name="pre_flip_barrier"))
+        flip_qos_on()
+        # steady eager segment: repeated identical rounds arm and then
+        # serve the response cache; dispatch plans on the cold calls
+        for i in range(5):
+            np.asarray(hvd.allreduce(jnp.full((4,), float(r)),
+                                     op=hvd.Sum, name="steady"))
+        # explicit-cut flush segment under QoS admission
+        hs = [hvd.allreduce_async(jnp.full((8,), float(r + i)),
+                                  op=hvd.Sum, name=f"q{i}")
+              for i in range(4)]
+        hvd.fusion_flush()
+        [np.asarray(h.result()) for h in hs]
+        return "OK"
+
+    outs = w.run(body, timeout=600)
+    bad = [o.error for o in outs if o.result != "OK"]
+    assert not bad, bad
+print("COMPOSITE_OK")
+"""
+
+
+class TestCompositeWorld16:
+    def test_composite_world16_diffs_clean(self, tmp_path):
+        """The acceptance run: world=16 (hierarchical control plane
+        auto-engaged) with response cache + QoS + step capture all on,
+        conformance recording — zero divergences, zero FSM violations,
+        and every subsystem's stream actually populated."""
+        env = dict(os.environ)
+        env.pop("HVD_FAULT_SPEC", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        env["PYTHONPATH"] = (str(REPO_ROOT) + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        env["CONF_DIR"] = str(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-c", _COMPOSITE_SCRIPT], cwd=REPO_ROOT,
+            env=env, capture_output=True, text=True, timeout=900)
+        assert proc.returncode == 0 and "COMPOSITE_OK" in proc.stdout, (
+            f"stdout:\n{proc.stdout[-3000:]}\nstderr:"
+            f"\n{proc.stderr[-4000:]}")
+
+        findings, errors, summary = hvdtrace.run_check([str(tmp_path)])
+        assert errors == []
+        assert summary["traces"] == 16
+        assert summary["divergences"] == 0, \
+            [hvdtrace.format_finding(f) for f in findings]
+        assert summary["fsm_violations"] == 0, \
+            [hvdtrace.format_finding(f) for f in findings]
+        assert summary["incomplete_groups"] == 0
+        # the composite actually exercised the subsystems it claims to:
+        # every conformance stream (including the QoS-flip epoch move)
+        # is live in the traces
+        docs, _ = hvdtrace.load_traces([str(tmp_path)])
+        streams = {e[1] for d in docs for e in d["events"]}
+        for required in ("flush", "capture", "rcache", "plans", "qos",
+                         "service", "epoch"):
+            assert required in streams, streams
+
+
+# ---------------------------------------------------------------------------
+# the two planted divergence demos
+# ---------------------------------------------------------------------------
+
+
+class TestPlantedDivergences:
+    def test_knob_skew_found_and_localized(self, tmp_path):
+        """Demo (a): one rank runs with a skewed HVD_FUSION_THRESHOLD —
+        its flushes split where everyone else coalesces. Without the
+        instrument this is the generic exchange-deadline hang; with it,
+        the differ names the flush site and the odd rank out."""
+        n = 4
+        base = {**PINNED, **DEMO_BOUND, "HVD_CONFORMANCE": "1",
+                "HVD_CONFORMANCE_DIR": str(tmp_path)}
+
+        def body():
+            r = hvd.rank()
+            hs = [hvd.allreduce_async(jnp.full((1024,), float(r + i)),
+                                      op=hvd.Sum, name=f"s{i}")
+                  for i in range(6)]
+            hvd.fusion_flush()
+            [np.asarray(h.result()) for h in hs]
+            return "OK"
+
+        w = hvd.loopback.LoopbackWorld(n, name="skew")
+        try:
+            handles = []
+            for r in range(n):
+                extra = dict(base)
+                if r == 1:
+                    extra["HVD_FUSION_THRESHOLD"] = "1024"
+                handles.append(w.spawn(body, w.rank_env(r, n, extra=extra),
+                                       auto_init=True))
+            for h in handles:
+                h.wait()
+            # the skew deadlocks negotiation; the bounded deadline fails
+            # the ranks instead of hanging for 600 s
+            assert any(h.outcome.error is not None for h in handles)
+        finally:
+            w.shutdown()
+
+        findings, _errors, summary = hvdtrace.run_check([str(tmp_path)])
+        assert summary["traces"] == n
+        divs = [f for f in findings if f["type"] == "divergence"
+                and f["stream"] == "flush"]
+        # rank 1 is the only divergent rank: exactly the rank0-vs-rank1
+        # comparison trips, localized to the FIRST flush event
+        assert len(divs) == 1, [hvdtrace.format_finding(f)
+                                for f in findings]
+        f0 = divs[0]
+        assert (f0["rank_a"], f0["rank_b"]) == ("rank0", "rank1")
+        assert f0["index"] == 0
+        assert f0["a"]["site"] == FLUSH_SITE
+        # both compositions quoted: 6 coalesced names vs the split flush
+        assert "s5" in f0["a"]["payload"]
+        assert "s5" not in f0["b"]["payload"]
+
+    def test_rank_conditioned_flush_found_and_localized(self, tmp_path):
+        """Demo (b): rank 0 cuts its queue mid-stream with a
+        rank-conditioned ``fusion_flush()`` — the canonical
+        rank-divergent control flow bug (hvdlint pass 7's dynamic
+        twin)."""
+        n = 4
+        extra = {**PINNED, **DEMO_BOUND, "HVD_CONFORMANCE": "1",
+                 "HVD_CONFORMANCE_DIR": str(tmp_path)}
+        with hvd.loopback.world(n, extra_env=extra) as w:
+            def body():
+                r = hvd.rank()
+                hs = [hvd.allreduce_async(jnp.full((4,), float(r + i)),
+                                          op=hvd.Sum, name=f"c{i}")
+                      for i in range(3)]
+                if r == 0:
+                    hvd.fusion_flush()  # the planted bug
+                hs += [hvd.allreduce_async(jnp.full((4,), float(r + i)),
+                                           op=hvd.Sum, name=f"c{3 + i}")
+                       for i in range(3)]
+                hvd.fusion_flush()
+                [np.asarray(h.result()) for h in hs]
+                return "OK"
+
+            outs = w.run(body, timeout=120, allow_failures=True)
+            assert any(o.error is not None for o in outs)
+
+        findings, _errors, summary = hvdtrace.run_check([str(tmp_path)])
+        assert summary["traces"] == n
+        divs = [f for f in findings if f["type"] == "divergence"
+                and f["stream"] == "flush"]
+        # rank 0 (the reference) diverges from every other rank
+        assert len(divs) == n - 1, [hvdtrace.format_finding(f)
+                                    for f in findings]
+        for f0 in divs:
+            assert f0["rank_a"] == "rank0"
+            assert f0["index"] == 0
+            assert f0["a"]["site"] == FLUSH_SITE
+            # rank 0's first flush carries only the early cut's tensors
+            assert "c5" not in f0["a"]["payload"]
+            assert "c5" in f0["b"]["payload"]

@@ -1156,7 +1156,8 @@ class TestRepoGate:
         from tools.hvdlint import PASSES
         assert list(PASSES) == ["issue-lock", "lock-order", "timer-purity",
                                 "knob-registry", "donation", "silent-except",
-                                "rank-divergence", "metrics-registry"]
+                                "rank-divergence", "metrics-registry",
+                                "trace-coverage"]
 
     def test_cli_json_report(self, tmp_path):
         import json as _json

@@ -1,6 +1,6 @@
 """hvdlint: project-invariant static analysis for the horovod_tpu runtime.
 
-Eight AST passes, each encoding a concurrency/determinism invariant that
+Nine AST passes, each encoding a concurrency/determinism invariant that
 a PR introduced and a future regression would break silently (a hang or
 a cross-rank divergence, not a test failure):
 
@@ -30,9 +30,14 @@ metrics-registry telemetry flows through the unified metrics registry
                  (``horovod_tpu/metrics.py``): no ad-hoc module-level
                  counters/dicts, instrument catalog centralized there,
                  and the catalog round-trips with docs/metrics.md
+trace-coverage   every conformance decision point registered in
+                 ``conformance.SITES`` contains its ``record(...)``
+                 call, no ``record()`` sits outside the registry, and
+                 the registry round-trips with docs/conformance.md
 ===============  ============================================================
 
-Run ``python -m tools.hvdlint horovod_tpu`` from the repo root; findings
+Run ``python -m tools.hvdlint horovod_tpu`` from the repo root (add
+``--root tools`` to lint the analysis tools themselves); findings
 print as ``file:line: [pass] message`` and a nonzero exit fails CI
 (``--json`` emits the same findings as structured records plus per-pass
 timing). Suppress a vetted exception inline with

@@ -1,10 +1,15 @@
-"""CLI: ``python -m tools.hvdlint <package-dir> [--pass NAME]...
-[--json] [--list]``.
+"""CLI: ``python -m tools.hvdlint <package-dir> [--root DIR]...
+[--pass NAME]... [--json] [--list]``.
 
 Exit status: 0 = clean, 1 = findings, 2 = usage error. The package
 argument is the path to the analyzed package relative to the repo root
 (normally ``horovod_tpu``); docs are resolved as ``docs/knobs.md``
-next to it. ``--json`` replaces the line-per-finding output with one
+next to it. ``--root DIR`` adds further package roots to the same run
+(repeatable) — ``python -m tools.hvdlint horovod_tpu --root tools``
+lints the analysis tools with the suite that lints the runtime;
+registry round-trip checks that need runtime files (``utils/envs.py``,
+``metrics.py``, ``conformance.py``) skip themselves for roots that
+lack them. ``--json`` replaces the line-per-finding output with one
 JSON document — ``{file, line, pass, message}`` records plus per-pass
 wall-time — for structured consumers (the ci.sh annotation step).
 """
@@ -27,6 +32,10 @@ def main(argv=None) -> int:
     parser.add_argument("package", nargs="?", default="horovod_tpu",
                         help="package directory to analyze "
                              "(default: horovod_tpu)")
+    parser.add_argument("--root", dest="roots", action="append",
+                        metavar="DIR",
+                        help="additional package root to analyze in the "
+                             "same run (repeatable), e.g. --root tools")
     parser.add_argument("--pass", dest="passes", action="append",
                         metavar="NAME",
                         help="run only this pass (repeatable); "
@@ -45,20 +54,25 @@ def main(argv=None) -> int:
             print(f"{name}: {first.splitlines()[0] if first else ''}")
         return 0
 
-    pkg = Path(args.package)
-    root = pkg.parent if pkg.parent != Path("") else Path(".")
-    if not (root / pkg.name).is_dir():
-        print(f"hvdlint: package directory {args.package!r} not found",
-              file=sys.stderr)
-        return 2
-    project = Project(root, package_rel=pkg.name)
+    packages = [args.package] + list(args.roots or [])
+    findings = []
     timings: dict[str, float] = {}
-    try:
-        findings = run_all(project, args.passes, timings=timings)
-    except KeyError as e:
-        print(f"hvdlint: {e.args[0]}", file=sys.stderr)
-        return 2
-    n_files = len(project.files)
+    n_files = 0
+    for package in packages:
+        pkg = Path(package)
+        root = pkg.parent if pkg.parent != Path("") else Path(".")
+        if not (root / pkg.name).is_dir():
+            print(f"hvdlint: package directory {package!r} not found",
+                  file=sys.stderr)
+            return 2
+        project = Project(root, package_rel=pkg.name)
+        try:
+            findings.extend(run_all(project, args.passes,
+                                    timings=timings))
+        except KeyError as e:
+            print(f"hvdlint: {e.args[0]}", file=sys.stderr)
+            return 2
+        n_files += len(project.files)
     ran_names = args.passes if args.passes else list(PASSES)
     if args.json:
         counts: dict[str, int] = {}
@@ -66,7 +80,7 @@ def main(argv=None) -> int:
             counts[f.pass_name] = counts.get(f.pass_name, 0) + 1
         print(json.dumps({
             "tool": "hvdlint",
-            "package": str(pkg),
+            "package": " ".join(packages),
             "files": n_files,
             "clean": not findings,
             "findings": [{"file": f.path, "line": f.line,

@@ -11,6 +11,7 @@ from . import (
     rank_divergence,
     silent_except,
     timer_purity,
+    trace_coverage,
 )
 
 # name -> run(project) -> list[Finding]; keep the catalog order stable so
@@ -24,4 +25,5 @@ PASSES = {
     silent_except.NAME: silent_except.run,
     rank_divergence.NAME: rank_divergence.run,
     metrics_registry.NAME: metrics_registry.run,
+    trace_coverage.NAME: trace_coverage.run,
 }

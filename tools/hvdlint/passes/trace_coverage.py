@@ -1,0 +1,192 @@
+"""trace-coverage pass: the conformance decision-point registry, the
+``conformance.record(...)`` call sites, and ``docs/conformance.md``
+agree exactly.
+
+Invariant (``horovod_tpu/conformance.py``): the lockstep conformance
+instrument is only as good as its coverage — a decision point that is
+registered in :data:`SITES` but never records is a silent blind spot
+(``tools/hvdtrace`` would report a diverging world clean), and a
+``record()`` call outside the registry produces events the offline
+differ cannot classify (stream/class fall back to permissive
+defaults). The knob-registry pass's pattern, applied to trace
+coverage:
+
+1. **registry -> site**: every site key in ``SITES``
+   (``"<file>::<qualname>"``) must name a real function in the
+   package, and that function body must contain a
+   ``conformance.record(...)`` call whose first argument is the site's
+   own key as a string literal;
+2. **site -> registry**: every resolved ``conformance.record(...)``
+   call in the package (outside ``conformance.py`` itself) must pass a
+   string-literal site key that is registered AND matches the file +
+   enclosing function it actually sits in — a copy-pasted key from
+   another site mislabels every event it emits;
+3. **doc round-trip**: the site keys in ``SITES`` and the
+   ``file::qualname`` tokens in ``docs/conformance.md`` must match
+   exactly in both directions.
+
+When the analyzed package has no ``conformance.py`` (linting
+``tools/`` itself), the pass is a no-op — the registry lives with the
+runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, FuncInfo, Project
+
+NAME = "trace-coverage"
+
+_DOC_REL = "docs/conformance.md"
+_SITE_TOKEN = re.compile(
+    r"\b[A-Za-z0-9_][A-Za-z0-9_/]*\.py::[A-Za-z_][A-Za-z0-9_.]*")
+# The recorder's own epoch-move events carry this site; it is internal
+# (emitted from inside Recorder.note, not a hooked decision point) but
+# documented, so the doc round-trip must accept it.
+_INTERNAL_SITES = {"conformance.py::Recorder.note"}
+
+
+def _sites_literal(conf_sf) -> dict[str, int]:
+    """``SITES`` keys -> declaration line, from the module-level dict
+    literal in conformance.py."""
+    out: dict[str, int] = {}
+    for node in conf_sf.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "SITES"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                out[key.value] = key.lineno
+    return out
+
+
+def _record_calls(project: Project, info: FuncInfo, conf_rel: str):
+    """Yield ``conformance.record(...)`` / ``record(...)`` calls inside
+    ``info`` whose callee resolves (through the module's import aliases)
+    to the conformance module."""
+    aliases = project.func_imports(info)
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "record"
+                and isinstance(func.value, ast.Name)
+                and aliases.get(func.value.id) == conf_rel):
+            yield node
+        elif (isinstance(func, ast.Name)
+              and aliases.get(func.id) == conf_rel):
+            # `from .. import conformance` then `conformance(...)` can't
+            # happen; this arm catches `from ..conformance import record`
+            if func.id == "record":
+                yield node
+
+
+def _site_of(project: Project, info: FuncInfo) -> str:
+    rel = info.file.rel
+    prefix = f"{project.package_rel}/"
+    if rel.startswith(prefix):
+        rel = rel[len(prefix):]
+    return f"{rel}::{info.qualname}"
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    conf_rel = f"{project.package_rel}/conformance.py"
+    conf_sf = project.by_rel.get(conf_rel)
+    if conf_sf is None:
+        return findings  # linting a tree without the runtime registry
+    sites = _sites_literal(conf_sf)
+    if not sites:
+        findings.append(Finding(
+            NAME, conf_rel, 1,
+            "conformance.py defines no SITES literal — the decision-"
+            "point registry must be a module-level dict of string keys"))
+        return findings
+
+    # index: registered site -> the literal keys actually recorded there
+    recorded_at: dict[str, set[str]] = {}
+    for info in project.functions():
+        if info.file.rel == conf_rel:
+            continue  # the recorder's own internals are not hooked sites
+        here = _site_of(project, info)
+        for call in _record_calls(project, info, conf_rel):
+            if info.file.suppressed(NAME, call.lineno):
+                continue
+            arg = call.args[0] if call.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                findings.append(Finding(
+                    NAME, info.file.rel, call.lineno,
+                    "conformance.record(...) site key must be a string "
+                    "literal — computed keys are invisible to this "
+                    "coverage check and to the docs round-trip"))
+                continue
+            key = arg.value
+            if key not in sites:
+                findings.append(Finding(
+                    NAME, info.file.rel, call.lineno,
+                    f"conformance.record({key!r}): site is not "
+                    "registered in conformance.SITES — unregistered "
+                    "events fall back to a permissive stream/class the "
+                    "offline differ cannot validate"))
+            elif key != here:
+                findings.append(Finding(
+                    NAME, info.file.rel, call.lineno,
+                    f"conformance.record({key!r}) called from {here!r}: "
+                    "the site key must name the file + function it sits "
+                    "in, or every event it emits is mislabeled"))
+            recorded_at.setdefault(here, set()).add(key)
+
+    # every registered site resolves to a real function that records
+    for site, line in sorted(sites.items()):
+        rel, _, qualname = site.partition("::")
+        info = project.func(f"{project.package_rel}/{rel}", qualname)
+        if info is None:
+            if not conf_sf.suppressed(NAME, line):
+                findings.append(Finding(
+                    NAME, conf_rel, line,
+                    f"SITES registers {site!r} but no such function "
+                    "exists in the package (renamed or removed "
+                    "decision point — update the registry)"))
+            continue
+        if site not in recorded_at.get(site, set()):
+            if not conf_sf.suppressed(NAME, line):
+                findings.append(Finding(
+                    NAME, conf_rel, line,
+                    f"SITES registers {site!r} but the function contains "
+                    "no conformance.record(...) call with that key — an "
+                    "unhooked decision point is a blind spot hvdtrace "
+                    "reports as clean"))
+
+    # doc round-trip, both directions
+    doc_path = project.root / _DOC_REL
+    if not doc_path.exists():
+        findings.append(Finding(
+            NAME, _DOC_REL, 1,
+            "docs/conformance.md is missing — the decision-point "
+            "registry must be documented"))
+        return findings
+    doc_sites: dict[str, int] = {}
+    for i, line_text in enumerate(doc_path.read_text().splitlines(),
+                                  start=1):
+        for m in _SITE_TOKEN.finditer(line_text):
+            doc_sites.setdefault(m.group(0), i)
+    for site, line in sorted(sites.items()):
+        if site not in doc_sites:
+            findings.append(Finding(
+                NAME, conf_rel, line,
+                f"site {site} is registered in conformance.SITES but "
+                f"undocumented in {_DOC_REL}"))
+    for site, line in sorted(doc_sites.items()):
+        if site in sites or site in _INTERNAL_SITES:
+            continue
+        findings.append(Finding(
+            NAME, _DOC_REL, line,
+            f"{_DOC_REL} documents site {site}, which is not in "
+            "conformance.SITES (stale entry, or the registration is "
+            "missing)"))
+    return findings

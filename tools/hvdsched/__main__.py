@@ -8,7 +8,11 @@ every planted bug (detector sanity). ``--replay FILE`` re-runs one
 recorded schedule byte-for-byte from a JSON ``{model, seed, trace}``.
 
 Exit status: 0 = gate passed, 1 = findings (or a demo not found),
-2 = usage error.
+2 = usage error. ``--json`` replaces the per-model text lines with one
+JSON document carrying each model's exploration accounting (schedules
+run, branch points, pruned/swept counts, findings) plus the budget
+split — structured consumers (the ci.sh starvation gate) check that
+the ceil-divided per-model budget left no model under-explored.
 """
 
 from __future__ import annotations
@@ -30,8 +34,9 @@ def _ensure_env() -> None:
     os.environ["HVD_SCHED_CHECK"] = "1"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # models deliberately simulate failures (poison records, aborts);
-    # their ERROR logs are expected output, not gate noise
-    os.environ.setdefault("HVD_LOG_LEVEL", "fatal")
+    # their ERROR logs are expected output, not gate noise. CLI-layer
+    # seeding BEFORE the runtime imports — the registry isn't up yet.
+    os.environ.setdefault("HVD_LOG_LEVEL", "fatal")  # hvdlint: disable=knob-registry
     from horovod_tpu.utils import invariants
     invariants.refresh()
 
@@ -59,6 +64,10 @@ def main(argv=None) -> int:
     parser.add_argument("--replay", metavar="FILE",
                         help="replay one schedule from a JSON file "
                              "{model, seed, trace}")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="emit one JSON report (per-model explored-"
+                             "schedule accounting + budget split) "
+                             "instead of text lines")
     parser.add_argument("--list", action="store_true",
                         help="list models and exit")
     args = parser.parse_args(argv)
@@ -112,29 +121,62 @@ def main(argv=None) -> int:
     # per-model split must round up, never shave the total under it
     per_model = max(-(-budget // max(len(pool), 1)), 1)
     failed = False
+    records: list[dict] = []
     for name, fn in pool.items():
         t0 = time.perf_counter()
         result = explore(fn, schedules=per_model, seed=seed,
                          max_steps=args.max_steps)
         dt = time.perf_counter() - t0
+        records.append({
+            "model": name,
+            "demo": bool(args.demos),
+            "seconds": round(dt, 3),
+            "runs": result.runs,
+            "branch_points": result.branch_points,
+            "pruned": result.pruned,
+            "swept": result.swept,
+            "findings": len(result.findings),
+            "found": not result.ok,
+        })
         if args.demos:
             found = not result.ok
-            print(f"{name}: planted bug "
-                  f"{'FOUND' if found else 'NOT FOUND'} — "
-                  f"{result.summary()} [{dt:.1f}s]")
+            if not args.as_json:
+                print(f"{name}: planted bug "
+                      f"{'FOUND' if found else 'NOT FOUND'} — "
+                      f"{result.summary()} [{dt:.1f}s]")
             if found:
                 f0 = result.findings[0]
-                print(f"  kind={f0.kind} seed={f0.seed} "
-                      f"trace={f0.trace!r}")
+                if not args.as_json:
+                    print(f"  kind={f0.kind} seed={f0.seed} "
+                          f"trace={f0.trace!r}")
             else:
                 failed = True
+                if args.as_json:
+                    print(f"hvdsched: demo {name!r} NOT FOUND",
+                          file=sys.stderr)
         else:
-            print(f"{name}: {result.summary()} [{dt:.1f}s]")
+            if not args.as_json:
+                print(f"{name}: {result.summary()} [{dt:.1f}s]")
             for f0 in result.findings:
                 failed = True
+                # replay coordinates survive --json runs on stderr: a
+                # structured consumer (the ci.sh gate) must never eat
+                # the (seed, trace) a human needs to reproduce
+                out = sys.stderr if args.as_json else sys.stdout
                 print(f"--- {name} finding "
-                      f"(replay: --model {name} + seed/trace below)")
-                print(str(f0))
+                      f"(replay: --model {name} + seed/trace below)",
+                      file=out)
+                print(str(f0), file=out)
+    if args.as_json:
+        print(json.dumps({
+            "tool": "hvdsched",
+            "demos": bool(args.demos),
+            "budget": budget,
+            "per_model": per_model,
+            "models": len(pool),
+            "clean": not failed,
+            "results": records,
+        }, indent=2))
     return 1 if failed else 0
 
 

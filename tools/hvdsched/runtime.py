@@ -226,8 +226,8 @@ class Runtime:
                 for w in lock._waiters:
                     w.state = RUNNABLE
                 lock._waiters.clear()
-            except Exception:
-                pass
+            except Exception:  # hvdlint: disable=silent-except
+                pass  # best-effort unwedge of a simulated lock's guts
         task.held.clear()
         for j in task.joiners:
             if j.state == BLOCKED and j.wait_kind == "join":
@@ -562,7 +562,9 @@ class Runtime:
                 break
             for t in alive:
                 t.gate.release()
-            time.sleep(0.001)
+            # simulated-scheduler drain tick, not an I/O retry: the
+            # unified backoff policy is part of the system under test
+            time.sleep(0.001)  # hvdlint: disable=silent-except
         for t in self.tasks.values():
             if t.thread is not None and t.thread.is_alive():
                 t.thread.join(timeout=1.0)

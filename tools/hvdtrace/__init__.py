@@ -1,0 +1,422 @@
+"""hvdtrace: cross-rank conformance trace differ + protocol FSM validator.
+
+The offline half of the lockstep conformance instrument
+(``horovod_tpu/conformance.py`` is the runtime half): given the per-rank
+trace files a conformance-enabled world dumped at shutdown/abort (or via
+``hvd.conformance_dump()``), this tool
+
+1. **groups** traces into comparable worlds by the rendezvous
+   coordinates every trace header carries — ``(world, round, size,
+   generation)`` — so one directory of dumps from an elastic run with
+   many re-formed rounds diffs each round against itself;
+2. **cross-diffs** every lockstep stream against the lowest-rank
+   reference: the digest fast path compares final chain values (equal
+   chains + equal event counts prove the whole stream byte-identical),
+   and on mismatch a **binary search** over the cumulative per-event
+   chain values localizes the FIRST divergent event — the chain at
+   index *i* equals iff every event up to *i* matched, so prefix
+   equality is monotone and bisectable;
+3. **validates** each rank's trace against the protocol FSM — capture
+   phase legality (seal only while recording, replay completion only
+   from replay, no explicit transition into the implicit ``replayed``
+   state), response-cache warm-handshake ordering (a non-empty confirm
+   needs a prior non-empty restore), service lifecycle (events need a
+   preceding ``svc_start``; no join after a coordinated abort), no
+   locally-served batches after this rank joined, and knob-override
+   epoch chaining/monotonicity.
+
+Divergence reports quote both ranks' full payloads from the bounded
+ring (when the event is still inside the ring window), the decision
+site, and each side's knob-override epoch at the divergence point —
+the localization the 600 s exchange-deadline hang never gives you.
+
+Stdlib-only, like tools/hvdlint: the differ must run in CI (and on a
+workstation over scp'd trace files) without importing the runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+
+__all__ = [
+    "load_traces", "group_traces", "diff_group", "validate_fsm",
+    "format_finding", "run_check",
+]
+
+TRACE_SCHEMA = 1
+LOCKSTEP = "lockstep"
+LOCAL = "local"
+
+# events rows are [seq, stream, cls, site, kind, crc]; ring rows are
+# [seq, site, kind, repr(payload)]
+_E_SEQ, _E_STREAM, _E_CLS, _E_SITE, _E_KIND, _E_CRC = range(6)
+
+_EPOCH_STREAM = "epoch"
+
+
+# ---------------------------------------------------------------------------
+# loading + grouping
+# ---------------------------------------------------------------------------
+
+
+def load_traces(paths) -> tuple[list[dict], list[str]]:
+    """Load trace documents from files and/or directories (directories
+    expand to their ``hvdtrace-*.json``). Returns ``(docs, errors)`` —
+    an unreadable or wrong-schema file is an error string, not a crash:
+    a partial dump from an aborted world must not mask the diff of the
+    ranks that did dump."""
+    docs: list[dict] = []
+    errors: list[str] = []
+    files: list[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.glob("hvdtrace-*.json")))
+        else:
+            files.append(path)
+    for path in files:
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable trace ({e})")
+            continue
+        if not isinstance(doc, dict) or "events" not in doc:
+            errors.append(f"{path}: not a conformance trace document")
+            continue
+        if doc.get("schema") != TRACE_SCHEMA:
+            errors.append(f"{path}: unsupported trace schema "
+                          f"{doc.get('schema')!r} (expected {TRACE_SCHEMA})")
+            continue
+        doc["_path"] = str(path)
+        docs.append(doc)
+    return docs, errors
+
+
+def group_key(doc: dict) -> tuple:
+    return (doc.get("world", ""), doc.get("round", ""),
+            doc.get("size", -1), doc.get("generation", 0))
+
+
+def group_traces(docs: list[dict]) -> dict[tuple, dict[str, dict]]:
+    """``(world, round, size, generation) -> {label: doc}``. A rank that
+    dumped more than once in an incarnation (on-demand dump + shutdown
+    dump) keeps the longest trace — the others are prefixes of it."""
+    groups: dict[tuple, dict[str, dict]] = {}
+    for doc in docs:
+        key = group_key(doc)
+        label = doc.get("label") or f"rank{doc.get('rank', '?')}"
+        held = groups.setdefault(key, {}).get(label)
+        if held is None or doc.get("n_events", 0) > held.get("n_events", 0):
+            groups[key][label] = doc
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# cross-rank diff
+# ---------------------------------------------------------------------------
+
+
+def _stream_events(doc: dict, stream: str) -> list[list]:
+    return [e for e in doc.get("events", [])
+            if e[_E_STREAM] == stream and e[_E_CLS] == LOCKSTEP]
+
+
+def _ring_payload(doc: dict, seq: int) -> str | None:
+    for row in doc.get("ring", []):
+        if row[0] == seq:
+            return row[3]
+    return None
+
+
+def _epoch_at(doc: dict, seq: int):
+    """The knob-override epoch in force when event ``seq`` was recorded:
+    the payload ``(old, new)`` of the last epoch event before it (new),
+    or None when no epoch move was ever observed (epoch 0 throughout)."""
+    last = None
+    for e in doc.get("events", []):
+        if e[_E_SEQ] >= seq:
+            break
+        if e[_E_STREAM] == _EPOCH_STREAM:
+            last = e
+    if last is None:
+        return None
+    payload = _ring_payload(doc, last[_E_SEQ])
+    if payload is None:
+        return f"crc:{last[_E_CRC]}"
+    try:
+        return ast.literal_eval(payload)[1]
+    except (ValueError, SyntaxError, IndexError, TypeError):
+        return payload
+
+
+def _first_divergent_index(a_ev: list[list], b_ev: list[list]) -> int:
+    """Smallest stream index where the chains disagree. The recorded crc
+    at index i is the cumulative chain AFTER event i, so "prefix
+    [0..i] identical" is a monotone predicate — binary search it."""
+    n = min(len(a_ev), len(b_ev))
+    lo, hi = 0, n  # invariant: prefix [0..lo) equal, first diff < hi
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if a_ev[mid][_E_CRC] == b_ev[mid][_E_CRC]:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo  # == n when the shared prefix fully matches (length skew)
+
+
+def _event_view(doc: dict, ev: list | None) -> dict | None:
+    if ev is None:
+        return None
+    return {
+        "seq": ev[_E_SEQ],
+        "site": ev[_E_SITE],
+        "kind": ev[_E_KIND],
+        "crc": ev[_E_CRC],
+        "payload": _ring_payload(doc, ev[_E_SEQ]),
+        "epoch": _epoch_at(doc, ev[_E_SEQ]),
+    }
+
+
+def diff_group(key: tuple, by_label: dict[str, dict]) -> list[dict]:
+    """Cross-diff one comparable world: every rank's lockstep streams
+    against the lowest-rank reference. Returns finding dicts."""
+    world, rnd, size, generation = key
+    base = {"world": world, "round": rnd, "size": size,
+            "generation": generation}
+    findings: list[dict] = []
+    docs = sorted(by_label.values(), key=lambda d: (d.get("rank", 1 << 30),
+                                                    d.get("label", "")))
+    if isinstance(size, int) and size > 0 and len(docs) < size:
+        have = [d.get("label") for d in docs]
+        findings.append({**base, "type": "missing-ranks",
+                         "have": have,
+                         "missing": size - len(docs)})
+    if len(docs) < 2:
+        return findings
+    ref = docs[0]
+    streams: list[str] = []
+    for d in docs:
+        for s in d.get("chains", {}):
+            if s not in streams:
+                streams.append(s)
+    for other in docs[1:]:
+        for stream in streams:
+            a_ev = _stream_events(ref, stream)
+            b_ev = _stream_events(other, stream)
+            # digest fast path: equal final chains + equal counts prove
+            # the whole stream identical without touching the events
+            if (len(a_ev) == len(b_ev)
+                    and ref.get("chains", {}).get(stream, 0)
+                    == other.get("chains", {}).get(stream, 0)):
+                continue
+            i = _first_divergent_index(a_ev, b_ev)
+            a = a_ev[i] if i < len(a_ev) else None
+            b = b_ev[i] if i < len(b_ev) else None
+            if a is None and b is None:
+                # counts matched but final chains differed — impossible
+                # unless a trace was hand-edited; report it as-is
+                pass
+            findings.append({
+                **base, "type": "divergence", "stream": stream,
+                "index": i,
+                "rank_a": ref.get("label"), "rank_b": other.get("label"),
+                "a": _event_view(ref, a), "b": _event_view(other, b),
+            })
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# per-rank protocol FSM
+# ---------------------------------------------------------------------------
+
+
+def _parse_payload(row):
+    try:
+        return ast.literal_eval(row[3])
+    except (ValueError, SyntaxError):
+        return None
+
+
+def validate_fsm(doc: dict) -> list[dict]:
+    """Validate one rank's trace against the protocol FSM. Payload-level
+    rules read the bounded ring; when the ring no longer covers the
+    trace head (``HVD_CONFORMANCE_RING`` smaller than the event count),
+    "must be preceded by" rules are suppressed for the unseen prefix
+    rather than reported as violations."""
+    findings: list[dict] = []
+    base = {"world": doc.get("world", ""), "round": doc.get("round", ""),
+            "generation": doc.get("generation", 0),
+            "rank": doc.get("label") or f"rank{doc.get('rank', '?')}"}
+
+    def flag(rule: str, row, detail: str) -> None:
+        findings.append({**base, "type": "fsm", "rule": rule,
+                         "seq": row[0], "site": row[1], "kind": row[2],
+                         "payload": row[3], "detail": detail})
+
+    ring = list(doc.get("ring", []))
+    truncated = bool(ring) and ring[0][0] > 0
+
+    capture_state: str | None = None
+    warm_ok: dict = {}          # pset -> a non-empty restore is pending
+    started: set = set()        # psets with an observed svc_start
+    aborted: set = set()        # psets under a coordinated abort
+    joined: set = set()         # psets this rank joined
+    prev_epoch = None
+
+    for row in ring:
+        _seq, site, kind, _payload = row
+        payload = _parse_payload(row)
+
+        if site.startswith("ops/step_capture.py::"):
+            if kind == "phase" and isinstance(payload, (list, tuple)) \
+                    and len(payload) == 2:
+                frm, to = payload
+                if to == "replayed":
+                    flag("capture-phase", row,
+                         "explicit transition into 'replayed' — that "
+                         "state is only entered implicitly when a "
+                         "sealed step's replay completes")
+                if capture_state is not None and frm != capture_state:
+                    flag("capture-phase", row,
+                         f"phase claims from={frm!r} but the previous "
+                         f"event left the state at {capture_state!r}")
+                capture_state = to
+            elif kind == "seal":
+                if capture_state is not None and capture_state != "record":
+                    flag("capture-seal", row,
+                         f"seal while state={capture_state!r} — a step "
+                         "can only seal from 'record'")
+            elif kind == "replayed":
+                if capture_state is not None and capture_state != "replay":
+                    flag("capture-replay", row,
+                         f"replay completion while state="
+                         f"{capture_state!r} — only legal from 'replay'")
+                capture_state = "replayed"
+
+        elif site.startswith("negotiation/response_cache.py::"):
+            pset = payload[0] if isinstance(payload, (list, tuple)) \
+                and payload else None
+            n = payload[1] if isinstance(payload, (list, tuple)) \
+                and len(payload) > 1 else None
+            if kind == "warm_restore":
+                if isinstance(n, int) and n > 0:
+                    warm_ok[pset] = True
+            elif kind == "warm_confirm":
+                if isinstance(n, int) and n > 0 \
+                        and not warm_ok.get(pset) and not truncated:
+                    flag("warm-order", row,
+                         "non-empty warm confirm without a preceding "
+                         "non-empty warm restore for this process set")
+                warm_ok[pset] = False
+            elif kind == "warm_drop":
+                warm_ok[pset] = False
+            elif kind == "served":
+                if pset in joined:
+                    flag("served-after-join", row,
+                         "batch served from the response cache after "
+                         "this rank joined — the join latch must end "
+                         "local serving (docs/negotiation.md 'Joins')")
+
+        elif site.startswith("engine_service.py::"):
+            pset = payload[0] if isinstance(payload, (list, tuple)) \
+                and payload else None
+            if kind == "svc_start":
+                started.add(pset)
+                aborted.discard(pset)
+                joined.discard(pset)
+            else:
+                if pset not in started and not truncated:
+                    flag("service-lifecycle", row,
+                         f"{kind} for process set {pset!r} without a "
+                         "preceding svc_start")
+                if kind == "svc_abort":
+                    aborted.add(pset)
+                elif kind == "join":
+                    if pset in aborted:
+                        flag("service-lifecycle", row,
+                             "join after a coordinated abort — an "
+                             "aborted service only stops")
+                    joined.add(pset)
+
+        elif kind == "epoch":
+            if isinstance(payload, (list, tuple)) and len(payload) == 2:
+                old, new = payload
+                if prev_epoch is not None and old != prev_epoch:
+                    flag("epoch-chain", row,
+                         f"epoch move claims old={old!r} but the "
+                         f"previous move ended at {prev_epoch!r}")
+                if isinstance(old, int) and isinstance(new, int) \
+                        and new <= old:
+                    flag("epoch-chain", row,
+                         f"non-monotone epoch move {old} -> {new}")
+                prev_epoch = new
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+
+def _fmt_side(label: str, view: dict | None) -> str:
+    if view is None:
+        return f"    {label}: (no further events in this stream)"
+    payload = view["payload"]
+    quoted = payload if payload is not None else \
+        f"(aged out of ring; chain crc={view['crc']})"
+    return (f"    {label}: seq={view['seq']} {view['site']} "
+            f"{view['kind']} payload={quoted}")
+
+
+def format_finding(f: dict) -> str:
+    where = f"world={f.get('world')!r} round={f.get('round')!r}"
+    if f["type"] == "divergence":
+        lines = [f"DIVERGENCE {where} stream={f['stream']} "
+                 f"index={f['index']}: {f['rank_a']} vs {f['rank_b']}",
+                 _fmt_side(f["rank_a"], f["a"]),
+                 _fmt_side(f["rank_b"], f["b"])]
+        ea = (f["a"] or {}).get("epoch")
+        eb = (f["b"] or {}).get("epoch")
+        if ea is not None or eb is not None:
+            lines.append(f"    override epochs: {f['rank_a']}={ea} "
+                         f"{f['rank_b']}={eb}")
+        return "\n".join(lines)
+    if f["type"] == "fsm":
+        return (f"FSM {where} {f['rank']}: [{f['rule']}] seq={f['seq']} "
+                f"{f['site']} {f['kind']} payload={f['payload']} — "
+                f"{f['detail']}")
+    if f["type"] == "missing-ranks":
+        return (f"INCOMPLETE {where} size={f.get('size')}: "
+                f"{f['missing']} rank trace(s) missing "
+                f"(have {', '.join(f['have'])}) — a rank that never "
+                "dumped usually died before shutdown; check its log")
+    return repr(f)
+
+
+def run_check(paths, fsm: bool = True) -> tuple[list[dict], list[str],
+                                                dict]:
+    """Load, group, diff, and FSM-validate. Returns
+    ``(findings, errors, summary)``."""
+    docs, errors = load_traces(paths)
+    groups = group_traces(docs)
+    findings: list[dict] = []
+    for key in sorted(groups, key=repr):
+        findings.extend(diff_group(key, groups[key]))
+    if fsm:
+        for doc in docs:
+            findings.extend(validate_fsm(doc))
+    summary = {
+        "traces": len(docs),
+        "groups": [
+            {"world": k[0], "round": k[1], "size": k[2],
+             "generation": k[3], "ranks": sorted(groups[k])}
+            for k in sorted(groups, key=repr)],
+        "divergences": sum(1 for f in findings
+                           if f["type"] == "divergence"),
+        "fsm_violations": sum(1 for f in findings if f["type"] == "fsm"),
+        "incomplete_groups": sum(1 for f in findings
+                                 if f["type"] == "missing-ranks"),
+    }
+    return findings, errors, summary
