@@ -2416,6 +2416,223 @@ def run_autoscale_bench(args):
     }))
 
 
+def run_ckpt_recovery_bench(args):
+    """Recovery-SLO lane for the checkpoint state plane
+    (docs/checkpoint.md; BENCH_r18). For each model size, the IDENTICAL
+    4->3->4 churn (graceful preempt, then a joiner that must be
+    restored) runs twice:
+
+    * **peer** — ``HVD_CKPT_PEER_RESTORE=1`` (the default): the joiner
+      pulls per-rank shards from the survivors, so rank 0 serves only
+      its ``1/len(survivors)`` share of the tree.
+    * **broadcast** — ``HVD_CKPT_PEER_RESTORE=0``: the reference rank-0
+      object broadcast, which re-syncs EVERY rank's full tree through
+      rank 0.
+
+    The gated numbers are the deterministic byte counters
+    (``hvd_ckpt_restore_bytes_total{source=}``) measured as deltas from
+    after the initial world formation (both lanes pay the same fresh
+    broadcast there): peer must serve fewer rank-0 bytes than broadcast
+    at EVERY size and its growth with model size must be sub-linear vs
+    the broadcast baseline's. Wall-clock restore seconds ride along
+    informationally — on a contended CI box they swing with scheduler
+    noise. A final probe re-runs the smallest size with
+    ``ckpt.shard_pull:error`` injected on every serve: the typed
+    degraded path must fire exactly there and nowhere else."""
+    from horovod_tpu.loopback.engine import _seed_xla_device_flags
+
+    world_n = args.ckpt_recovery_world
+    _seed_xla_device_flags(world_n + 1)
+
+    from horovod_tpu.utils import faults
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.loopback import elastic_run
+
+    base_env = {
+        "HVD_RESPONSE_CACHE": "1",
+        "HVD_HEALTH_INTERVAL": "0.3",
+        "HVD_HEALTH_TIMEOUT": "4",
+        "HVD_METRICS": "1",
+    }
+    steps = args.ckpt_recovery_steps
+    sleep_s = args.ckpt_recovery_step_sleep
+    sizes = sorted(int(s) for s in
+                   str(args.ckpt_recovery_sizes).split(","))
+    churn_spec = (
+        f"worker:preempt:rank={world_n - 1}:at_round=1:at_step=4"
+        ":grace=30;"
+        "worker:add:rank=0:at_round=2:after=4:count=1")
+
+    # 8 equal param leaves: shards partition the FLATTENED tree by
+    # leaf, so a single monolithic array would land whole in one
+    # survivor's range and make rank 0's measured share degenerate
+    n_parts = 8
+
+    def lane(n_floats, peer_on, inject=None):
+        spec = churn_spec + (";" + inject if inject else "")
+        os.environ["HVD_FAULT_SPEC"] = spec
+        faults.refresh()
+        from horovod_tpu import metrics as _metrics
+        _ckpt_insts = (_metrics.CKPT_RESTORE_BYTES,
+                       _metrics.CKPT_PEER_SHARDS_PULLED,
+                       _metrics.CKPT_DEGRADED_RESTORES,
+                       _metrics.CKPT_RESTORE_SECONDS)
+        # isolate this lane from earlier lanes in the same process
+        _metrics.reset_all(*_ckpt_insts)
+        box = {}
+
+        def body():
+            import horovod_tpu as _hvd
+            from horovod_tpu import metrics as _metrics
+
+            def tot(inst):
+                # metric stores are per rank context (the joiner's pull
+                # counters live on ITS thread's store): sum every store
+                agg = {}
+                for s in _metrics._all_stores():
+                    for k, v in inst.series(s).items():
+                        agg[k] = agg.get(k, 0) + v
+                return agg
+
+            _hvd.init()
+            part = np.zeros(max(1, n_floats // n_parts), np.float32)
+            state = _hvd.elastic.JaxState(
+                params={f"w{i}": part.copy() for i in range(n_parts)},
+                step=0, trans=0, lastw=0, p_ok=True)
+
+            @_hvd.elastic.run
+            def train(state):
+                cap = steps * 4
+                while state.step < cap and not (
+                        state.step >= steps and state.trans >= 2):
+                    if state.step == 0:
+                        # founding ranks drop their formation-broadcast
+                        # bytes from their OWN store so the lane counts
+                        # only re-form restores; the joiner enters with
+                        # the restored step > 0 and never resets — its
+                        # pull counters are exactly what we measure
+                        for inst in (
+                                _metrics.CKPT_RESTORE_BYTES,
+                                _metrics.CKPT_PEER_SHARDS_PULLED,
+                                _metrics.CKPT_DEGRADED_RESTORES,
+                                _metrics.CKPT_RESTORE_SECONDS):
+                            inst.reset()
+                    probe = _hvd.allreduce(jnp.arange(8.0) + 1.0,
+                                           op=_hvd.Sum, name="probe")
+                    flat = np.asarray(probe).reshape(-1)
+                    world = int(round(float(flat[0])))
+                    if abs(float(flat[1]) - 2.0 * world) > 1e-6:
+                        state.p_ok = False
+                    if state.lastw and world != state.lastw:
+                        state.trans += 1
+                    state.lastw = world
+                    state.params = {
+                        k: v + np.float32(1.0)
+                        for k, v in state.params.items()}
+                    state.step += 1
+                    time.sleep(sleep_s)
+                    state.commit()
+                return state.step, state.trans, state.p_ok
+
+            step_n, trans, p_ok = train(state)
+            if _hvd.rank() == 0:
+                srcs = {}
+                for k, v in tot(_metrics.CKPT_RESTORE_BYTES).items():
+                    src = dict(k).get("source", "?")
+                    srcs[src] = srcs.get(src, 0) + int(v)
+                rs_sum, rs_count = 0.0, 0
+                for s in _metrics._all_stores():
+                    for h in _metrics.CKPT_RESTORE_SECONDS.series(
+                            s).values():
+                        rs_sum += h.sum
+                        rs_count += h.count
+                box["result"] = {
+                    "rank0_bytes": srcs.get("rank0", 0),
+                    "peer_bytes": srcs.get("peer", 0),
+                    "shards_pulled": int(sum(tot(
+                        _metrics.CKPT_PEER_SHARDS_PULLED).values())),
+                    "degraded": int(sum(tot(
+                        _metrics.CKPT_DEGRADED_RESTORES).values())),
+                    "steps": int(step_n),
+                    "transitions": int(trans),
+                    "numerics_ok": bool(p_ok),
+                    "restore_s_sum": round(rs_sum, 3),
+                    "restore_count": int(rs_count),
+                }
+            return 0
+
+        env = dict(base_env)
+        env["HVD_CKPT_PEER_RESTORE"] = "1" if peer_on else "0"
+        results, ok = elastic_run(
+            body, np=world_n, min_np=2, max_np=world_n,
+            discovery=FixedHosts({f"h{i}": 1 for i in range(world_n)}),
+            timeout=180, extra_env=env)
+        if not ok or "result" not in box:
+            return None, (results.error_message or "no rank-0 result")
+        return box["result"], None
+
+    t0 = time.monotonic()
+    lanes = []
+    err = None
+    for n_floats in sizes:
+        row = {"size": n_floats, "tree_bytes": n_floats * 4}
+        for key, peer_on in (("peer", True), ("broadcast", False)):
+            res, lane_err = lane(n_floats, peer_on)
+            if lane_err:
+                err = f"{key} lane at size {n_floats}: {lane_err}"
+                break
+            row[key] = res
+        if err:
+            break
+        row["ratio"] = (
+            round(row["peer"]["rank0_bytes"]
+                  / row["broadcast"]["rank0_bytes"], 4)
+            if row["broadcast"]["rank0_bytes"] else None)
+        lanes.append(row)
+
+    degraded_probe = None
+    if err is None:
+        degraded_probe, probe_err = lane(
+            sizes[0], True, inject="ckpt.shard_pull:error")
+        if probe_err:
+            err = f"degraded probe: {probe_err}"
+    elapsed = time.monotonic() - t0
+
+    if err is not None:
+        print(json.dumps({
+            "metric": "ckpt_recovery_rank0_bytes",
+            "value": None,
+            "unit": "peer/broadcast rank-0 restore bytes at the "
+                    "largest model size",
+            "error": err[:500],
+        }))
+        return
+
+    print(json.dumps({
+        "metric": "ckpt_recovery_rank0_bytes",
+        "value": lanes[-1]["ratio"],
+        "unit": "peer/broadcast rank-0 restore bytes at the largest "
+                "model size over the IDENTICAL 4->3->4 churn (<1.0 = "
+                "the sharded peer restore serves measurably fewer "
+                "bytes through rank 0 than the reference broadcast; "
+                "~1/survivors = rank 0 serves only its own shard)",
+        "world": world_n,
+        "schedule": churn_spec,
+        "sizes": sizes,
+        "lanes": lanes,
+        "degraded_probe": degraded_probe,
+        "numerics_ok": bool(
+            all(r[k]["numerics_ok"] for r in lanes
+                for k in ("peer", "broadcast"))
+            and degraded_probe["numerics_ok"]),
+        "elapsed_s": round(elapsed, 1),
+        "fast_health": {"interval_s": 0.3, "timeout_s": 4.0},
+        "baseline": "the same churn with HVD_CKPT_PEER_RESTORE=0: the "
+                    "reference rank-0 object broadcast re-syncing every "
+                    "rank's full tree through rank 0",
+    }))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=256,
@@ -2671,6 +2888,33 @@ def main():
                              "fault-injected slow rank is evicted and "
                              "named, and adversarial flapping produces "
                              "no oscillation")
+    parser.add_argument("--ckpt-recovery-bench", action="store_true",
+                        help="checkpoint state-plane recovery-SLO lane "
+                             "(docs/checkpoint.md; BENCH_r18): the "
+                             "identical 4->3->4 churn per model size "
+                             "with peer-restore on vs the rank-0 "
+                             "broadcast baseline, gated on the "
+                             "deterministic hvd_ckpt_restore_bytes "
+                             "counters, plus an injected "
+                             "ckpt.shard_pull probe that must take the "
+                             "typed degraded path")
+    parser.add_argument("--ckpt-recovery-world", type=int, default=4,
+                        help="starting loopback world size for "
+                             "--ckpt-recovery-bench")
+    parser.add_argument("--ckpt-recovery-steps", type=int, default=16,
+                        help="committed steps per lane in "
+                             "--ckpt-recovery-bench (the lane runs on "
+                             "until both churn transitions were "
+                             "observed, capped at 4x)")
+    parser.add_argument("--ckpt-recovery-step-sleep", type=float,
+                        default=0.02,
+                        help="seconds of compute stand-in per step in "
+                             "--ckpt-recovery-bench")
+    parser.add_argument("--ckpt-recovery-sizes",
+                        default="8192,65536,262144",
+                        help="comma-separated float32 param counts (the "
+                             "model-size sweep of --ckpt-recovery-bench"
+                             "; default 32 KB / 256 KB / 1 MB trees)")
     parser.add_argument("--serve-bench", action="store_true",
                         help="run the multi-tenant inference-serving QoS "
                              "benchmark (CPU backend, no accelerator "
@@ -2743,6 +2987,8 @@ def main():
         return run_elastic_bench(args)
     if args.autoscale_bench:
         return run_autoscale_bench(args)
+    if args.ckpt_recovery_bench:
+        return run_ckpt_recovery_bench(args)
 
     if args.max_wait > 0 and not wait_for_backend(args.max_wait):
         # Claiming the backend ourselves now would either fail identically

@@ -340,11 +340,15 @@ step "1j/6 schedule-exploration gate (hvdsched race matrix; docs/schedule_checke
 # fixtures (lock inversion, missed signal, unguarded PR-3/PR-6 shapes,
 # the planted QoS priority-inversion) must all be FOUND. Wall-clock
 # capped; any finding dumps its (seed, trace) replay line.
-# budgets scale with the registries: 12 matrix models x 24, 9 demos x 22
+# budgets scale with the registries: 13 matrix models x 24, 10 demos x 22
 # (ISSUE 13 added hier-negotiation + leader-lost-wakeup; ISSUE 14 added
-# elastic-reform + stale-plan-after-resize-demo; ISSUE 15 adds
+# elastic-reform + stale-plan-after-resize-demo; ISSUE 15 added
 # autoscale-decision (round-tagged policy apply racing a watchdog
-# re-form and a commit waiter) + the planted evict-during-reform-demo).
+# re-form and a commit waiter) + the planted evict-during-reform-demo;
+# the state plane adds ckpt-snapshot (snapshot writer racing commits
+# and teardown; docs/checkpoint.md) + the planted
+# stale-manifest-restore-demo (pointer read without a generation
+# re-check against the manifest write)).
 # The matrix runs --json and a starvation gate reads the per-model
 # accounting: explore() drives every clean model to its ceil-split
 # budget, so runs < SCHED_MODEL_FLOOR means the registry outgrew
@@ -353,7 +357,7 @@ step "1j/6 schedule-exploration gate (hvdsched race matrix; docs/schedule_checke
 # trace) replay lines on stderr in --json mode.
 SCHED_MODEL_FLOOR="${SCHED_MODEL_FLOOR:-16}"
 sched_rc=0
-HVD_SCHED_CHECK=1 timeout -k 10 300 python -m tools.hvdsched --schedules 288 --json \
+HVD_SCHED_CHECK=1 timeout -k 10 300 python -m tools.hvdsched --schedules 320 --json \
   > /tmp/hvd_sched_matrix.json || sched_rc=$?
 # rc 0/1 = a report was emitted; anything else (timeout, crash) has its
 # real signal on stderr — don't bury it under a JSONDecodeError
@@ -368,7 +372,7 @@ starved = [(r["model"], r["runs"]) for r in d["results"]
            if r["runs"] < floor]
 assert not starved, (
     "budget ceil-split starved model(s) under the %d-schedule floor: %r"
-    " — the model registry outgrew --schedules 288" % (floor, starved))
+    " — the model registry outgrew --schedules 320" % (floor, starved))
 print("sched matrix OK: %d models x %d schedules (floor %d), "
       "%d branched, %d pruned as equivalent, %d seed-swept" % (
           d["models"], d["per_model"], floor,
@@ -378,7 +382,7 @@ print("sched matrix OK: %d models x %d schedules (floor %d), "
 EOF
 fi
 [ "$sched_rc" -eq 0 ]
-HVD_SCHED_CHECK=1 timeout -k 10 300 python -m tools.hvdsched --demos --schedules 198
+HVD_SCHED_CHECK=1 timeout -k 10 300 python -m tools.hvdsched --demos --schedules 220
 
 step "1l/6 loopback chaos gate (world=4 rank death under HVD_DEBUG_INVARIANTS=1; docs/loopback.md)"
 # The loopback world's failure-domain acceptance (ISSUE 10): an
@@ -670,6 +674,71 @@ composed_bench_gate || {
   }
 }
 tail -1 /tmp/hvd_composed_bench.out > BENCH_r17.json
+
+step "1u/6 checkpoint recovery-SLO gate (sharded peer-restore vs rank-0 broadcast; docs/checkpoint.md)"
+# ISSUE 18 acceptance at loopback world=4: over the IDENTICAL 4->3->4
+# churn at three model sizes, the peer restore must serve FEWER rank-0
+# bytes than the HVD_CKPT_PEER_RESTORE=0 broadcast baseline at EVERY
+# size and grow sub-linearly against it (rank 0 serves only its own
+# shard; the broadcast re-syncs every rank's full tree through rank 0),
+# the joiner must actually pull shards (and pull none in the baseline
+# lanes), and a ckpt.shard_pull:error probe must take the typed
+# degraded path exactly where injected and nowhere else. Gated on the
+# deterministic hvd_ckpt_* byte/pull/degraded counters — restore
+# wall-clock rides along informationally. Fresh-process retries like
+# 1i/1q. The passing run's artifact is BENCH_r18.json.
+ckpt_recovery_gate() {
+python bench.py --ckpt-recovery-bench | tee /tmp/hvd_ckpt_recovery.out | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+assert d.get('error') is None, d.get('error')
+assert d['numerics_ok'] is True, d
+lanes = d['lanes']
+assert len(lanes) >= 3, 'model-size sweep incomplete: %r' % lanes
+for row in lanes:
+    peer, bc = row['peer'], row['broadcast']
+    assert peer['rank0_bytes'] < bc['rank0_bytes'], \
+        'peer restore served no fewer rank-0 bytes at size %d: %r' % (
+            row['size'], row)
+    assert peer['shards_pulled'] > 0, \
+        'peer lane pulled no shards at size %d: %r' % (row['size'], peer)
+    assert bc['shards_pulled'] == 0, \
+        'broadcast lane pulled shards at size %d: %r' % (row['size'], bc)
+    assert peer['degraded'] == 0 and bc['degraded'] == 0, \
+        'uninjected lane degraded at size %d: %r' % (row['size'], row)
+    assert peer['transitions'] >= 2 and bc['transitions'] >= 2, \
+        'churn incomplete at size %d: %r' % (row['size'], row)
+# sub-linear growth vs the baseline: as the model grows, the peer
+# lane's rank-0 bytes must grow by LESS than the broadcast lane's
+pg = lanes[-1]['peer']['rank0_bytes'] - lanes[0]['peer']['rank0_bytes']
+bg = (lanes[-1]['broadcast']['rank0_bytes']
+      - lanes[0]['broadcast']['rank0_bytes'])
+assert pg < bg, \
+    'peer rank-0 bytes did not grow sub-linearly vs broadcast: %r vs %r' \
+    % (pg, bg)
+assert d['value'] is not None and d['value'] < 0.5, \
+    'peer/broadcast rank-0 byte ratio not under 0.5: %r' % d['value']
+probe = d['degraded_probe']
+assert probe['degraded'] > 0, \
+    'injected ckpt.shard_pull probe never took the typed degraded ' \
+    'path: %r' % probe
+assert probe['transitions'] >= 2, 'degraded probe churn incomplete: %r' % probe
+print('ckpt recovery OK: rank0-byte ratio %.4f at the largest size '
+      '(floor <0.5), peer vs broadcast rank-0 bytes %s, growth %d vs '
+      '%d, degraded only when injected (%d)' % (
+          d['value'],
+          [(r['peer']['rank0_bytes'], r['broadcast']['rank0_bytes'])
+           for r in lanes],
+          pg, bg, probe['degraded']))"
+}
+ckpt_recovery_gate || {
+  echo "ckpt recovery attempt 1 failed; retrying in a fresh process"
+  ckpt_recovery_gate || {
+    echo "ckpt recovery attempt 2 failed; final retry in a fresh process"
+    ckpt_recovery_gate
+  }
+}
+tail -1 /tmp/hvd_ckpt_recovery.out > BENCH_r18.json
 
 if [[ "${1:-}" == "--fast" ]]; then
   step "fast: examples/mnist.py (hvdrun -np 2) then exit"

@@ -1,15 +1,34 @@
-"""Persistent (on-disk / cloud) checkpointing of sharded training state.
+"""Persistent (on-disk / cloud) checkpointing of sharded training state,
+plus the elastic **state plane**: background sharded snapshots and
+peer-restore on re-form (docs/checkpoint.md).
 
 The reference has no general checkpoint subsystem — its three scoped
 mechanisms (SURVEY.md §5.4) are the in-memory elastic ``State``
 commit/restore, init-time ``broadcast_parameters``, and the Spark
 estimators' ``Store`` persisting model state between epochs
 (``/root/reference/horovod/spark/common/store.py:1-582``, HDFS/S3/local
-backends). This module is the TPU-native unification SURVEY §5.4 calls
-for: orbax-backed checkpoints of sharded jax pytrees, usable standalone or
-as the durable layer under elastic training (commit to memory every few
-steps, checkpoint to disk every epoch; after a full job restart,
-``restore`` + ``hvd.broadcast_parameters`` resumes).
+backends). This module unifies both halves:
+
+* :class:`Checkpointer` — orbax-backed checkpoints of sharded jax
+  pytrees, usable standalone or as the durable layer under elastic
+  training (commit to memory every few steps, checkpoint to disk every
+  epoch; after a full job restart, ``restore`` +
+  ``hvd.broadcast_parameters`` resumes).
+
+* :class:`StatePlane` — the state twin of the elastic warm shelf
+  (docs/elastic.md): with ``HVD_CKPT_DIR`` set, a background thread
+  per rank copies the committed step's state off the critical path
+  every ``HVD_CKPT_INTERVAL`` commits, sharded by rank over the
+  flattened tree (each rank owns ``leaf_range(rank, world)``), each
+  shard an atomic temp-file+rename write with a crc digest sidecar;
+  rank 0 seals the step with an atomic manifest and only then moves
+  the ``latest`` pointer, so a reader can never observe a torn tree.
+  The restore half (:meth:`~horovod_tpu.elastic.state.JaxState.sync`)
+  re-syncs a re-formed world by pulling shards from survivors instead
+  of rank 0 rebroadcasting the whole tree — over the loopback hub
+  in-world, the KV transport as fallback — with digest verification on
+  every pulled shard and the rank-0 broadcast as the typed, metered
+  degraded path.
 
     import horovod_tpu as hvd
     mgr = hvd.checkpoint.Checkpointer("/ckpts/run1", max_to_keep=3)
@@ -20,14 +39,27 @@ steps, checkpoint to disk every epoch; after a full job restart,
 Orbax writes each shard from the process that owns it (the multi-host
 path), supports local paths and ``gs://`` buckets (via tensorstore), and
 restores arrays with the shardings of the ``target`` template — the
-mechanics the Spark ``Store`` delegates to HDFS clients.
+mechanics the Spark ``Store`` delegates to HDFS clients. The state
+plane's own format is deliberately stdlib-only (pickle + crc32 + atomic
+renames): restores must work in the narrow window where a re-formed
+world has not finished re-initializing its accelerator runtime.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import pickle
+import weakref
+import zlib
 from typing import Any
 
+from . import conformance as _conformance
+from . import metrics as _metrics
+from .loopback import context as _lbctx
+from .utils import envs
+from .utils import faults as _faults
+from .utils import invariants as _inv
 from .utils import logging as hvd_logging
 
 
@@ -112,7 +144,14 @@ def restore(directory: str, *, step: int | None = None,
 
 def restore_or_none(directory: str, *, target: Any = None) -> Any | None:
     """Restore the latest checkpoint, or None when the directory has none
-    (the resume-if-present idiom)."""
+    (the resume-if-present idiom). State-plane snapshot manifests are
+    preferred when present: the newest step whose manifest *and* every
+    shard digest verify wins, so a process killed mid-snapshot (torn
+    shards, no manifest) resumes from the previous complete step —
+    never a torn tree."""
+    plane = sharded_restore_or_none(directory, target=target)
+    if plane is not None:
+        return plane
     try:
         with Checkpointer(directory) as mgr:
             if mgr.latest_step() is None:
@@ -124,3 +163,671 @@ def restore_or_none(directory: str, *, target: Any = None) -> Any | None:
         hvd_logging.warning("checkpoint restore from %s failed: %s",
                             directory, e)
         return None
+
+
+# ===========================================================================
+# Production state plane: sharded async snapshots + peer-restore
+# (docs/checkpoint.md; the elastic warm shelf's state twin)
+# ===========================================================================
+
+MANIFEST_SCHEMA = 1
+
+# KV key prefix for peer shard hand-offs when no loopback hub carries
+# them (process worlds). Round-scoped keys; the driver GCs the whole
+# prefix at every round publication — a new round makes every pending
+# transfer stale by definition.
+PEER_KEY_PREFIX = "ckpt/peer/"
+
+# Snapshot-thread park slice: short enough that stop()/teardown is
+# prompt, long enough not to spin. Virtualized under HVD_SCHED_CHECK.
+_WAIT_SLICE_S = 0.2
+
+# How long rank 0's writer waits for the other ranks' shards before
+# abandoning a step's manifest (a peer's writer may be wedged or its
+# rank dead); an abandoned manifest simply leaves `latest` at the
+# previous complete step.
+_MANIFEST_WAIT_S = 60.0
+
+# Test seam: when set, the serving side maps a shard payload through
+# this hook (fn(tag, payload) -> payload) AFTER its digest is computed —
+# the deterministic way to manufacture a digest-mismatched shard and
+# exercise the reject/re-pull path.
+_corrupt_shard_hook = None
+
+
+def leaf_range(i: int, n: int, total: int) -> tuple[int, int]:
+    """Contiguous ``[lo, hi)`` slice of ``total`` flattened leaves owned
+    by participant ``i`` of ``n`` — balanced so the first ``total % n``
+    participants take one extra leaf. The single partition function both
+    the snapshot writers and the restore re-partitioning use: when the
+    world (or survivor set) size changes, ranges are simply recomputed
+    over the new ``n``."""
+    base, extra = divmod(total, n)
+    lo = i * base + min(i, extra)
+    return lo, lo + base + (1 if i < extra else 0)
+
+
+def shard_digest(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def structure_digest(leaves, treedef) -> int:
+    """Shape fingerprint of a flattened state tree: the treedef plus
+    every leaf's (shape, dtype) — or its type for non-array leaves.
+    Content-free on purpose: survivors and a fresh joiner built from the
+    same model code agree on structure while disagreeing on values."""
+    parts = [repr(treedef)]
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            parts.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            parts.append(("pyobj", type(leaf).__name__))
+    return zlib.crc32(repr(parts).encode()) & 0xFFFFFFFF
+
+
+def tree_nbytes(leaves) -> int:
+    """Approximate payload size of a flattened tree (array nbytes; 64 a
+    leaf for plain objects) — the broadcast-path restore meter."""
+    total = 0
+    for leaf in leaves:
+        total += int(getattr(leaf, "nbytes", 64))
+    return total
+
+
+def _shard_stem(lo: int, hi: int) -> str:
+    return f"shard-{lo}-{hi}"
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"manifest-{step}.json")
+
+
+def latest_path(directory: str) -> str:
+    return os.path.join(directory, "latest")
+
+
+def step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step-{step}")
+
+
+def _atomic_write(path: str, data: bytes, tag: str) -> None:
+    """Temp-file + rename: a reader sees the whole file or no file."""
+    tmp = f"{path}.tmp-{tag}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class StatePlane:
+    """One rank's background snapshot writer.
+
+    Created lazily at the first triggering commit (the ``State.commit``
+    seam, next to the autoscale observer) and dropped on every re-form
+    (``State.on_reset``) — rank numbers and world size are per-round
+    facts, so a plane never outlives its round. The writer thread is
+    built on the ``utils/invariants.py`` seam, so hvdsched's
+    ``ckpt-snapshot`` model explores it racing commits and teardown.
+
+    Hand-off is copy-free: the committed tree's leaves are host numpy
+    arrays that ``State.save()`` *replaces* (never mutates) on the next
+    commit, so the flattened slice handed to the thread is effectively
+    immutable. Latest-wins: a snapshot still pending when the next
+    trigger lands is replaced — the plane prefers a fresh restore point
+    over a complete history (the durable-history layer is
+    :class:`Checkpointer`)."""
+
+    def __init__(self, directory: str, *, rank: int, world: int,
+                 interval: int):
+        self.directory = directory
+        self.rank = rank
+        self.world = world
+        self.interval = max(1, interval)
+        self.last_manifest_step = -1  # rank 0 only
+        self._cv = _inv.make_condition("checkpoint.plane.cv")
+        self._pending = None  # (step, shard leaves, lo, hi, n_leaves)
+        self._stopped = False
+        self._thread = None
+        self._ctx = _lbctx.current()  # liveness probe for abrupt kills
+
+    # -- trigger (training thread, the commit boundary) --------------------
+
+    def note_commit(self, state) -> None:
+        """The ``State.commit()`` seam: on every ``interval``-th commit,
+        flatten the just-committed tree, take this rank's leaf range,
+        and hand it to the writer. The trigger itself is the lockstep
+        decision (every rank triggers at the same commit count with the
+        same partition); the write happens off-thread."""
+        step = state._commits
+        if step % self.interval != 0:
+            return
+        import jax
+        leaves, _treedef = jax.tree_util.tree_flatten(state._saved_state)
+        lo, hi = leaf_range(self.rank, self.world, len(leaves))
+        _conformance.record("checkpoint.py::StatePlane.note_commit",
+                            "snapshot", (step, self.world, len(leaves)))
+        with self._cv:
+            if self._stopped:
+                return
+            self._pending = (step, leaves[lo:hi], lo, hi, len(leaves))
+            if self._thread is None:
+                self._thread = _inv.spawn_thread(
+                    self._loop, name=f"hvd-ckpt-snapshot-r{self.rank}")
+            self._cv.notify_all()
+
+    def stop(self, *, join: bool = True) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        t, self._thread = self._thread, None
+        if t is not None and join:
+            _inv.join_thread(t, timeout=5)
+
+    # -- writer thread -----------------------------------------------------
+
+    def _dead(self) -> bool:
+        return self._ctx is not None and self._ctx.dead
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._stopped:
+                    if self._dead():
+                        return
+                    self._cv.wait(_WAIT_SLICE_S)
+                if self._pending is None:
+                    return  # stopped with nothing queued
+                job, self._pending = self._pending, None
+            try:
+                self._write_snapshot(*job)
+            except Exception as e:
+                # A failed snapshot costs freshness, never the job: the
+                # previous manifest stays `latest` and complete.
+                hvd_logging.warning(
+                    "ckpt: snapshot for step %d failed on rank %d: %s",
+                    job[0], self.rank, e)
+
+    def _write_snapshot(self, step: int, leaves, lo: int, hi: int,
+                        n_leaves: int) -> None:
+        t0 = _inv.monotonic()
+        # Chaos seam `ckpt.write` (docs/robustness.md): an injected error
+        # here is a rank killed mid-snapshot — shards already renamed
+        # stay, the sidecar/manifest never lands, `latest` never moves.
+        _faults.inject("ckpt.write", rank=self.rank, step=step)
+        payload = pickle.dumps(leaves, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = shard_digest(payload)
+        sdir = step_dir(self.directory, step)
+        os.makedirs(sdir, exist_ok=True)
+        stem = os.path.join(sdir, _shard_stem(lo, hi))
+        _atomic_write(stem + ".bin", payload, f"r{self.rank}")
+        # The sidecar is the shard's commit record: written (atomically)
+        # only after the payload rename, so sidecar-present implies
+        # shard-complete — the manifest writer polls sidecars only.
+        meta = {"lo": lo, "hi": hi, "digest": digest,
+                "nbytes": len(payload), "rank": self.rank}
+        _atomic_write(stem + ".json", json.dumps(meta).encode(),
+                      f"r{self.rank}")
+        _metrics.CKPT_SHARDS_WRITTEN.inc()
+        if self.rank == 0:
+            self._write_manifest(step, n_leaves)
+        _metrics.CKPT_SNAPSHOT_SECONDS.observe(_inv.monotonic() - t0)
+
+    def _write_manifest(self, step: int, n_leaves: int) -> None:
+        """Seal ``step``: wait for every rank's sidecar, then write the
+        manifest and move ``latest`` — both atomic, in that order, so
+        ``latest`` can only ever name a step whose manifest (and hence
+        every shard) is complete."""
+        expected = [leaf_range(r, self.world, n_leaves)
+                    for r in range(self.world)]
+        expected = [(lo, hi) for lo, hi in expected if hi > lo]
+        sdir = step_dir(self.directory, step)
+        deadline = _inv.monotonic() + _MANIFEST_WAIT_S
+        while True:
+            shards = []
+            for lo, hi in expected:
+                try:
+                    with open(os.path.join(
+                            sdir, _shard_stem(lo, hi) + ".json"), "rb") as f:
+                        shards.append(json.loads(f.read().decode()))
+                except (OSError, ValueError):
+                    shards = None
+                    break
+            if shards is not None:
+                break
+            with self._cv:
+                newer = self._pending is not None or self._stopped
+            if newer or self._dead() or _inv.monotonic() > deadline:
+                hvd_logging.warning(
+                    "ckpt: abandoning manifest for step %d (peer shards "
+                    "missing; latest stays at %d)", step,
+                    self.last_manifest_step)
+                return
+            _inv.sleep(_WAIT_SLICE_S / 4)
+        _faults.inject("ckpt.manifest", rank=self.rank, step=step)
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "step": step,
+            "world": self.world,
+            "n_leaves": n_leaves,
+            "shards": shards,
+        }
+        _atomic_write(manifest_path(self.directory, step),
+                      json.dumps(manifest).encode(), "m")
+        _atomic_write(latest_path(self.directory), str(step).encode(), "l")
+        self.last_manifest_step = step
+
+
+# -- per-world plane registry (the State.commit seam) -----------------------
+
+# RankContext -> StatePlane | False; weak keys so a dead round's planes
+# are collected with their contexts. `False` caches "state plane off"
+# so the per-commit fast path is one dict probe.
+_ctx_planes: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_process_plane: "StatePlane | bool | None" = None
+
+
+def _make_plane() -> "StatePlane | bool":
+    d = envs.ckpt_dir()
+    if not d:
+        return False
+    from . import runtime
+    if runtime.is_initialized():
+        rank, world = runtime.process_rank(), runtime.process_count()
+    else:
+        rank, world = envs.get_int(envs.RANK, 0), envs.get_int(envs.SIZE, 1)
+    return StatePlane(d, rank=rank, world=world,
+                      interval=envs.ckpt_interval())
+
+
+def note_commit(state) -> None:
+    """The ``State.commit()`` seam: near-zero when ``HVD_CKPT_DIR`` is
+    unset (one registry probe + cached miss)."""
+    ctx = _lbctx.current()
+    if ctx is None:
+        global _process_plane
+        plane = _process_plane
+        if plane is None:
+            plane = _process_plane = _make_plane()
+    else:
+        plane = _ctx_planes.get(ctx)
+        if plane is None:
+            plane = _make_plane()
+            _ctx_planes[ctx] = plane
+    if plane is not False:
+        plane.note_commit(state)
+
+
+def current_plane() -> "StatePlane | None":
+    """The calling thread's live plane, if one was created (tests and
+    the restore protocol's manifest fingerprint)."""
+    ctx = _lbctx.current()
+    plane = _process_plane if ctx is None else _ctx_planes.get(ctx)
+    return plane if isinstance(plane, StatePlane) else None
+
+
+def reset_plane() -> None:
+    """Stop and drop the calling thread's plane (re-form / teardown /
+    tests); the next commit re-reads the knobs under the new round's
+    rank and world."""
+    global _process_plane
+    ctx = _lbctx.current()
+    if ctx is None:
+        plane, _process_plane = _process_plane, None
+    else:
+        plane = _ctx_planes.pop(ctx, None)
+    if isinstance(plane, StatePlane):
+        plane.stop()
+
+
+# -- on-disk restore (full job restart) -------------------------------------
+
+def sharded_restore_or_none(directory: str, *, target: Any = None,
+                            step: int | None = None) -> Any | None:
+    """Reassemble a state-plane snapshot from ``directory``: the newest
+    step (or ``step``) whose manifest exists and whose every shard
+    passes its digest — walking older manifests when the newest is
+    incomplete or corrupt. Returns the unflattened tree (using
+    ``target``'s structure when given, else the survivors' recorded
+    structure cannot be recovered — the caller's template is the
+    treedef source) or None."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = []
+    for name in names:
+        if name.startswith("manifest-") and name.endswith(".json"):
+            try:
+                steps.append(int(name[len("manifest-"):-len(".json")]))
+            except ValueError:
+                continue
+    for s in sorted(steps, reverse=True):
+        if step is not None and s != step:
+            continue
+        tree = _load_manifest_step(directory, s, target)
+        if tree is not None:
+            return tree
+    return None
+
+
+def _load_manifest_step(directory: str, step: int, target) -> Any | None:
+    try:
+        with open(manifest_path(directory, step), "rb") as f:
+            manifest = json.loads(f.read().decode())
+        leaves: list = [None] * int(manifest["n_leaves"])
+        for meta in manifest["shards"]:
+            lo, hi = int(meta["lo"]), int(meta["hi"])
+            with open(os.path.join(step_dir(directory, step),
+                                   _shard_stem(lo, hi) + ".bin"),
+                      "rb") as f:
+                payload = f.read()
+            if shard_digest(payload) != int(meta["digest"]):
+                raise ValueError(
+                    f"shard [{lo},{hi}) digest mismatch at step {step}")
+            part = pickle.loads(payload)
+            if len(part) != hi - lo:
+                raise ValueError(
+                    f"shard [{lo},{hi}) holds {len(part)} leaves")
+            leaves[lo:hi] = part
+        if any(leaf is None for leaf in leaves):
+            raise ValueError(f"step {step} manifest leaves incomplete")
+        if target is None:
+            return leaves
+        import jax
+        t_leaves, treedef = jax.tree_util.tree_flatten(target)
+        if len(t_leaves) != len(leaves):
+            raise ValueError(
+                f"target has {len(t_leaves)} leaves, snapshot "
+                f"{len(leaves)}")
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    except Exception as e:
+        hvd_logging.warning(
+            "ckpt: snapshot step %d under %s unusable (%s); trying "
+            "older manifests", step, directory, e)
+        return None
+
+
+# -- peer-restore protocol (re-form state re-sync) --------------------------
+
+def peer_restore_active() -> bool:
+    """Whether re-form state re-sync should run the peer-restore
+    protocol instead of the rank-0 broadcast. Purely knob-driven
+    (``HVD_CKPT_PEER_RESTORE``, default on): the protocol serves from
+    the survivors' live committed trees, so it needs no snapshot
+    directory — ``HVD_CKPT_DIR`` only adds the on-disk restart story."""
+    return envs.ckpt_peer_restore_enabled()
+
+
+class RestorePlan:
+    """Every rank's identical view of one re-form restore, derived from
+    the allgathered fingerprints: who holds the committed step
+    (survivors), who needs it (needy), and why the world must degrade
+    to the rank-0 broadcast (``degraded_reason``) when it must."""
+
+    __slots__ = ("step", "world", "n_leaves", "survivors", "needy",
+                 "fresh", "degraded_reason")
+
+    def __init__(self, step, world, n_leaves, survivors, needy, fresh,
+                 degraded_reason):
+        self.step = step
+        self.world = world
+        self.n_leaves = n_leaves
+        self.survivors = tuple(survivors)
+        self.needy = tuple(needy)
+        self.fresh = fresh
+        self.degraded_reason = degraded_reason
+
+    def transfers(self, attempt: int, failed=()) -> list:
+        """The shard-pull schedule for ``attempt``: ``(needy, owner, k,
+        lo, hi)`` rows, sorted. Attempt 0 fans every needy rank over
+        every survivor's range; attempt 1 re-pulls only the failed
+        ``(needy, k)`` pairs from the NEXT survivor in the ring — the
+        bounded failover that turns one bad survivor into a retry, not
+        a degraded broadcast. Both sides walk this list in order, and
+        the orders nest (survivors serve needy-ascending, needy pull
+        range-ascending), so the rendezvous graph is acyclic."""
+        k_range = range(len(self.survivors))
+        items = ([(d, k) for d in self.needy for k in k_range]
+                 if attempt == 0 else sorted(failed))
+        out = []
+        for d, k in sorted(items):
+            owner = self.survivors[(k + attempt) % len(self.survivors)]
+            lo, hi = leaf_range(k, len(self.survivors), self.n_leaves)
+            if hi > lo:
+                out.append((d, owner, k, lo, hi))
+        return out
+
+
+def fingerprint_blob(rank: int, commits: int, leaves, treedef) -> dict:
+    plane = current_plane()
+    return {
+        "rank": rank,
+        "commits": int(commits),
+        "n_leaves": len(leaves),
+        "struct": structure_digest(leaves, treedef),
+        "manifest": plane.last_manifest_step if plane else -1,
+    }
+
+
+def make_restore_plan(blobs: list, *, world: int,
+                      quorum: int | None = None) -> RestorePlan:
+    """Derive the restore plan from every rank's fingerprint. Pure and
+    deterministic — each rank computes it independently from the same
+    allgathered input, which is what makes the plan itself a lockstep
+    conformance event."""
+    if quorum is None:
+        quorum = envs.ckpt_shard_quorum()
+    groups: dict[tuple, list[int]] = {}
+    for b in blobs:
+        key = (int(b["commits"]), int(b["n_leaves"]), int(b["struct"]))
+        groups.setdefault(key, []).append(int(b["rank"]))
+    max_commits = max(k[0] for k in groups)
+    if max_commits <= 0:
+        # Nobody has committed: the initial sync — rank 0's broadcast
+        # IS the correct (reference) behavior, not a degraded path.
+        return RestorePlan(0, world, 0, (), (), True, None)
+    best = [k for k in groups if k[0] == max_commits]
+    if len(best) > 1:
+        # Equally-committed survivors disagree on state structure: no
+        # consistent manifest exists to restore from.
+        return RestorePlan(max_commits, world, 0, (), (), False, "quorum")
+    key = best[0]
+    survivors = sorted(groups[key])
+    needy = sorted(r for k, ranks in groups.items() if k != key
+                   for r in ranks)
+    if len(survivors) < quorum:
+        return RestorePlan(key[0], world, key[1], survivors, needy,
+                           False, "quorum")
+    for k, ranks in groups.items():
+        if k != key and (k[1], k[2]) != (key[1], key[2]):
+            # A needy rank's tree shape disagrees: its template cannot
+            # absorb the survivors' leaves.
+            return RestorePlan(key[0], world, key[1], survivors, needy,
+                               False, "structure")
+    return RestorePlan(key[0], world, key[1], survivors, needy,
+                       False, None)
+
+
+def _transfer_timeout_s() -> float:
+    """Shard-pull deadline on the KV fallback channel: comfortably past
+    the watchdog budget (a dead owner must surface as the watchdog's
+    typed failure first, not as an anonymous pull timeout), floored for
+    slow shared CI filesystems."""
+    from . import health as _health
+    return max(2.0 * _health.watchdog_budget_s(), 20.0)
+
+
+def _kv_client():
+    addr = envs.get(envs.KV_ADDR)
+    if not addr:
+        return None
+    from .runner.http_kv import KVClient
+    return KVClient(addr, envs.get_int(envs.KV_PORT, 0),
+                    secret=envs.get(envs.SECRET_KEY))
+
+
+def peer_key(round_id: int, step: int, needy: int, owner: int,
+             lo: int, hi: int, attempt: int) -> str:
+    return (f"{PEER_KEY_PREFIX}{round_id}/{step}/"
+            f"{needy}-{owner}-{lo}-{hi}-{attempt}")
+
+
+def _serve_shard(tag, envelope, kv, round_id) -> None:
+    from .loopback import dispatch as _dispatch
+    ch = _dispatch.peer_channel(tag, 0)
+    if ch is not None:
+        ch.transfer(envelope)
+        return
+    if kv is None:
+        raise RuntimeError("no peer transport (no loopback hub, no KV)")
+    kv.put(peer_key(round_id, *tag), pickle.dumps(envelope))
+
+
+def _pull_shard(tag, kv, round_id) -> tuple:
+    """Returns ``(envelope, transport)``."""
+    from .loopback import dispatch as _dispatch
+    ch = _dispatch.peer_channel(tag, 1)
+    if ch is not None:
+        return ch.transfer(None), "hub"
+    if kv is None:
+        raise RuntimeError("no peer transport (no loopback hub, no KV)")
+    key = peer_key(round_id, *tag)
+    envelope = pickle.loads(kv.wait(key, timeout=_transfer_timeout_s()))
+    try:
+        kv.delete(key)
+    except Exception:  # hvdlint: disable=silent-except
+        pass  # best-effort GC: the driver deletes the round prefix anyway
+    return envelope, "kv"
+
+
+def run_peer_transfers(plan: RestorePlan, me: int, leaves, *,
+                       allgather, round_id: int = -1):
+    """Execute both sides of the shard-pull schedule for this rank.
+
+    Returns ``(new_leaves, reason)``: on success, needy ranks get the
+    fully assembled leaf list (survivors get None) and ``reason`` is
+    None; on an agreed failure every rank gets ``(None, reason)`` and
+    must take the degraded broadcast. All control decisions (who
+    failed, what retries, success) come out of ``allgather`` rounds,
+    so every rank branches identically."""
+    if not plan.needy:
+        return None, None
+    kv = None
+    from .loopback import dispatch as _dispatch
+    if _dispatch.peer_channel((plan.step, "probe"), 0) is None:
+        try:
+            kv = _kv_client()
+        except Exception as e:
+            hvd_logging.warning("ckpt: KV fallback unavailable: %s", e)
+    pulled: dict[int, list] = {}  # k -> leaves
+    failed: list = []
+    for attempt in (0, 1):
+        transfers = plan.transfers(attempt, failed)
+        my_failures = []
+        for d, owner, k, lo, hi in transfers:
+            tag = (plan.step, d, owner, lo, hi, attempt)
+            if me == owner:
+                try:
+                    # Chaos seam `ckpt.shard_pull`: an injected error is
+                    # a survivor failing to serve — it travels to the
+                    # puller as a typed refusal, which fails over to the
+                    # next survivor instead of degrading blind.
+                    _faults.inject("ckpt.shard_pull", rank=me,
+                                   step=plan.step)
+                    payload = pickle.dumps(
+                        leaves[lo:hi], protocol=pickle.HIGHEST_PROTOCOL)
+                    digest = shard_digest(payload)
+                    if _corrupt_shard_hook is not None:
+                        payload = _corrupt_shard_hook(tag, payload)
+                    envelope = ("ok", digest, payload)
+                except _faults.FaultInjected as e:
+                    envelope = ("err", str(e))
+                try:
+                    _serve_shard(tag, envelope, kv, round_id)
+                except _RECOVERABLE_TRANSFER_ERRORS as e:
+                    hvd_logging.warning(
+                        "ckpt: serving shard %s failed: %s", tag, e)
+            elif me == d:
+                try:
+                    envelope, transport = _pull_shard(tag, kv, round_id)
+                    part = _verify_shard(envelope, leaves, lo, hi)
+                    pulled[k] = part
+                    _metrics.CKPT_PEER_SHARDS_PULLED.inc(
+                        labels={"transport": transport})
+                    _metrics.CKPT_RESTORE_BYTES.inc(
+                        len(envelope[2]), labels={
+                            "source": "rank0" if owner == 0 else "peer"})
+                except _RECOVERABLE_TRANSFER_ERRORS as e:
+                    hvd_logging.warning(
+                        "ckpt: pull of shard %s failed (%s); will fail "
+                        "over", tag, e)
+                    my_failures.append((d, k))
+        statuses = allgather(("ckpt-status", attempt,
+                              sorted(my_failures)))
+        failed = sorted({(int(d), int(k)) for s in statuses
+                         for d, k in s[2]})
+        if not failed:
+            break
+    if failed:
+        return None, "pull-failed"
+    if me not in plan.needy:
+        return None, None
+    new_leaves: list = [None] * plan.n_leaves
+    for k, part in pulled.items():
+        lo, hi = leaf_range(k, len(plan.survivors), plan.n_leaves)
+        new_leaves[lo:hi] = part
+    if any(leaf is None for leaf in new_leaves):
+        # Cannot happen once every transfer succeeded; belt-and-braces
+        # against a plan/partition bug.
+        return None, "pull-failed"
+    return new_leaves, None
+
+
+class _ShardRejected(ValueError):
+    """A pulled shard failed verification (digest or shape) — recoverable
+    by failing over to another survivor."""
+
+
+_RECOVERABLE_TRANSFER_ERRORS: tuple = ()
+
+
+def _init_recoverable():
+    # Deliberately narrow: PeerFailureError / HostsUpdatedInterrupt are
+    # RuntimeError subclasses and MUST propagate (they are the elastic
+    # recovery loop's re-form triggers, the real failover for a survivor
+    # dying mid-serve), so no broad RuntimeError here — only the typed
+    # per-shard failures that the next survivor can absorb.
+    global _RECOVERABLE_TRANSFER_ERRORS
+    from .loopback.hub import ExchangeTimeout
+    _RECOVERABLE_TRANSFER_ERRORS = (
+        ExchangeTimeout, TimeoutError, OSError, _ShardRejected,
+        pickle.UnpicklingError, ValueError)
+
+
+_init_recoverable()
+
+
+def _verify_shard(envelope, template_leaves, lo: int, hi: int) -> list:
+    """Digest + structure verification on every pulled shard: the wire
+    digest guards the bytes, and each leaf must match this rank's own
+    template slice in shape/dtype — a self-consistently lying owner
+    cannot smuggle a mis-shaped tree past its puller."""
+    if not isinstance(envelope, tuple) or not envelope:
+        raise _ShardRejected(f"malformed envelope {type(envelope)}")
+    if envelope[0] != "ok":
+        raise _ShardRejected(f"owner refused: {envelope[1:]}")
+    _okc, digest, payload = envelope
+    if shard_digest(payload) != digest:
+        raise _ShardRejected("digest mismatch")
+    part = pickle.loads(payload)
+    if len(part) != hi - lo:
+        raise _ShardRejected(
+            f"expected {hi - lo} leaves, got {len(part)}")
+    for got, want in zip(part, template_leaves[lo:hi]):
+        if hasattr(want, "shape") and hasattr(want, "dtype"):
+            if (tuple(getattr(got, "shape", ())) != tuple(want.shape)
+                    or str(getattr(got, "dtype", "")) != str(want.dtype)):
+                raise _ShardRejected(
+                    f"leaf shape/dtype mismatch: {getattr(got, 'shape', None)}"
+                    f"/{getattr(got, 'dtype', None)} vs "
+                    f"{want.shape}/{want.dtype}")
+    return part

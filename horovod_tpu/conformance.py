@@ -124,6 +124,13 @@ SITES = {
     "engine_service.py::DynamicService._on_peer_failure":
         ("service", LOCAL),
     "engine_service.py::DynamicService.join": ("service", LOCAL),
+    # checkpoint state plane: snapshot triggers fire at the commit
+    # boundary on the training thread (the async writer only copies),
+    # and the re-form restore protocol's agree/source decisions are
+    # collective outputs — all three are lockstep by construction
+    "checkpoint.py::StatePlane.note_commit": ("ckpt", LOCKSTEP),
+    "elastic/state.py::JaxState.sync": ("ckpt", LOCKSTEP),
+    "elastic/state.py::JaxState._peer_restore": ("ckpt", LOCKSTEP),
 }
 
 # The internal stream the recorder feeds itself: knob-override epoch
@@ -131,7 +138,7 @@ SITES = {
 _EPOCH_STREAM = "epoch"
 
 STREAMS = ("flush", "qos", "capture", "rcache", "plans", "service",
-           _EPOCH_STREAM)
+           "ckpt", _EPOCH_STREAM)
 
 _STREAM_OF = {site: stream for site, (stream, _cls) in SITES.items()}
 _CLASS_OF = {site: cls for site, (_stream, cls) in SITES.items()}

@@ -31,6 +31,7 @@ import random
 import threading
 import time
 
+from .. import checkpoint as _checkpoint
 from ..runner import hosts as hosts_mod
 from ..utils import envs
 from ..utils import faults as _faults
@@ -132,6 +133,14 @@ class ElasticRendezvous:
             "world_size": len(slots),
             "slots": [_slot_to_dict(s) for s in slots],
         }
+        # A new round makes any pending checkpoint shard hand-off keys
+        # stale by definition (the peer-restore KV fallback channel,
+        # docs/checkpoint.md): a transfer interrupted by the very churn
+        # that triggered this round must not be mistaken for the re-run.
+        try:
+            self.kv.delete(_checkpoint.PEER_KEY_PREFIX.rstrip("/"))
+        except Exception:  # hvdlint: disable=silent-except
+            pass  # GC is best-effort; keys are also deleted per-tag
         # Order matters: workers wait on ROUND_KEY, so the spec must be
         # readable before the round number advances.
         self.kv.put(ROUND_SPEC_KEY.format(self._round), pickle.dumps(spec))

@@ -114,6 +114,7 @@ class CommitObserver:
         self._step_s_sum = 0.0
         self._prev_blames: dict[int, int] = {}
         self._prev_qos: tuple[float, int] = (0.0, 0)
+        self._prev_recovery: tuple[float, int] = (0.0, 0)
         self._client = None
         self._client_failed = False
 
@@ -164,6 +165,9 @@ class CommitObserver:
         qos_sum, qos_count = _qos_wait_totals()
         d_sum = qos_sum - self._prev_qos[0]
         d_count = qos_count - self._prev_qos[1]
+        rec_sum, rec_count = _recovery_totals()
+        dr_sum = rec_sum - self._prev_recovery[0]
+        dr_count = rec_count - self._prev_recovery[1]
         self._seq += 1
         blob = {
             "rank": envs.get_int(envs.RANK, self.rank),
@@ -175,11 +179,18 @@ class CommitObserver:
                             if self._steps else 0.0),
             "pending_bytes": float(_metrics.FUSION_PENDING_BYTES.value()),
             "qos_wait_s_mean": (d_sum / d_count if d_count else 0.0),
+            # Measured recovery cost (re-form + state restore, windowed
+            # delta): the scale-down brake's sensor — scaling down is
+            # only worth it when the restore the next re-form will pay
+            # stays inside the idle savings (docs/checkpoint.md).
+            "restore_s_sum": dr_sum,
+            "restore_count": dr_count,
             "straggler": {str(r): c for r, c in
                           sorted(blame_delta.items())},
         }
         self._prev_blames = blames
         self._prev_qos = (qos_sum, qos_count)
+        self._prev_recovery = (rec_sum, rec_count)
         self._steps = 0
         self._violations = 0
         self._step_s_sum = 0.0
@@ -198,6 +209,20 @@ def _qos_wait_totals() -> tuple[float, int]:
     for _labels, h in _metrics.QOS_ADMISSION_WAIT.series().items():
         total_s += getattr(h, "sum", 0.0)
         total_n += getattr(h, "count", 0)
+    return total_s, total_n
+
+
+def _recovery_totals() -> tuple[float, int]:
+    """(sum_s, count) across this rank's recovery-time series: the full
+    re-form spans (catch -> re-rendezvous -> re-sync) plus the state
+    restores measured by the checkpoint plane. Loopback ranks share one
+    process registry, so the driver-side mean divides out the world."""
+    total_s, total_n = 0.0, 0
+    for hist in (_metrics.ELASTIC_REFORM_SECONDS,
+                 _metrics.CKPT_RESTORE_SECONDS):
+        for _labels, h in hist.series().items():
+            total_s += getattr(h, "sum", 0.0)
+            total_n += getattr(h, "count", 0)
     return total_s, total_n
 
 
@@ -348,6 +373,12 @@ class AutoscalePolicy:
         self._idle_streak = 0
         self._blame_rank: int | None = None
         self._blame_streak = 0
+        # Running recovery-cost sensor (restore_s_sum/_count blob keys):
+        # lifetime totals, because re-forms are rare events — a windowed
+        # mean would usually be empty exactly when the remove decision
+        # needs it.
+        self._restore_s_sum = 0.0
+        self._restore_count = 0
         self._cooldown_until = 0.0
         self._last_seq: dict[tuple[int, int], int] = {}
         self._added = 0
@@ -452,6 +483,9 @@ class AutoscalePolicy:
                 blames[int(r)] = blames.get(int(r), 0) + int(c)
         dominant = (max(sorted(blames), key=lambda r: blames[r])
                     if blames else None)
+        for b in blobs:
+            self._restore_s_sum += float(b.get("restore_s_sum", 0.0))
+            self._restore_count += int(b.get("restore_count", 0))
 
         # -- streaks (hysteresis state) --
         self._breach_streak = self._breach_streak + 1 if breach else 0
@@ -478,8 +512,29 @@ class AutoscalePolicy:
         if self._idle_streak >= self.idle_windows:
             if world <= self.min_np:
                 return None  # at the floor
+            # Recovery-cost brake (docs/checkpoint.md): a remove triggers
+            # a re-form whose measured restore cost every surviving rank
+            # pays; when that projected cost exceeds the idle time the
+            # decision is trying to reclaim (the windows of idleness that
+            # justified it), shrinking loses throughput on net — hold.
+            cost = self._projected_restore_s()
+            savings = self.idle_windows * self.interval_s
+            if cost > savings:
+                return self._record(Decision(
+                    "hold", "restore-cost", round_id,
+                    detail=f"projected restore {cost:.2f}s exceeds idle "
+                           f"savings window {savings:.2f}s"))
             return self._apply_remove(round_id)
         return None
+
+    def _projected_restore_s(self) -> float:
+        """Mean measured per-rank recovery time (re-form + restore) —
+        the cost the next deliberate re-form is projected to pay. Zero
+        until a recovery has been observed: the first scale-down is
+        allowed on faith and funds the sensor for the rest."""
+        if self._restore_count <= 0:
+            return 0.0
+        return self._restore_s_sum / self._restore_count
 
     # -- actuation (round-tag re-validated) ---------------------------------
 

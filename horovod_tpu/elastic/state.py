@@ -20,6 +20,7 @@ import enum
 import functools
 import queue
 
+from .. import checkpoint as _ckpt
 from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils import faults as _faults
 from . import policy as _policy
@@ -55,6 +56,10 @@ class State:
         self._reset_callbacks.extend(callbacks)
 
     def on_reset(self):
+        # Rank numbers and world size are per-round facts: the state
+        # plane's snapshot writer (docs/checkpoint.md) is stopped and
+        # re-created lazily under the new round's partition.
+        _ckpt.reset_plane()
         self._host_messages = queue.Queue()
         self.reset()
         for callback in self._reset_callbacks:
@@ -79,6 +84,12 @@ class State:
         # with HVD_AUTOSCALE unset (cached observer miss).
         _policy.note_commit()
         self.save()
+        # State-plane seam (docs/checkpoint.md): with HVD_CKPT_DIR set,
+        # every HVD_CKPT_INTERVAL-th committed tree is handed to the
+        # background snapshot writer right here — the consistent commit
+        # point, after save() replaced the host copy, before a host
+        # update can interrupt. No-op otherwise (cached registry miss).
+        _ckpt.note_commit(self)
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -200,6 +211,110 @@ class JaxState(ObjectState):
             else getattr(self, attr)
             for attr in self._saved_state
         }
+
+    def sync(self):
+        """Re-sync state across a (re-)formed world.
+
+        With ``HVD_CKPT_PEER_RESTORE`` on (the default) and a real
+        multi-rank world, the re-sync is the peer-restore protocol
+        (docs/checkpoint.md): every rank allgathers a fingerprint of its
+        committed state, derives the identical :class:`RestorePlan`, and
+        joining/replacement ranks pull their shards from the survivors —
+        rank 0 serves only its 1/K share instead of rebroadcasting the
+        whole tree. Any degradation (no survivor quorum, structure
+        disagreement, unrecoverable pull failures) falls back to the
+        reference rank-0 broadcast, typed and metered — never silently.
+        """
+        if not self._saved_state:
+            return
+        import time as _time
+
+        from .. import metrics as _metrics
+        from .. import runtime as hvd_rt
+        t0 = _time.monotonic()
+        restored = False
+        plan = None
+        world = hvd_rt.process_count() if hvd_rt.is_initialized() else 1
+        if world > 1 and _ckpt.peer_restore_active():
+            import jax
+
+            from .. import conformance as _conformance
+            from .. import ops as hvd_ops
+            me = hvd_rt.process_rank()
+            leaves, treedef = jax.tree_util.tree_flatten(self._saved_state)
+            blob = _ckpt.fingerprint_blob(me, self._commits, leaves,
+                                          treedef)
+            blobs = hvd_ops.allgather_object(blob)
+            plan = _ckpt.make_restore_plan(blobs, world=world)
+            # Lockstep by construction: every rank derives the plan from
+            # the same allgathered fingerprints.
+            _conformance.record(
+                "elastic/state.py::JaxState.sync", "manifest_agree",
+                (plan.step, plan.survivors, plan.needy, plan.n_leaves,
+                 plan.degraded_reason))
+            if not plan.fresh:
+                restored = self._peer_restore(plan, me, leaves, treedef)
+        if not restored:
+            # The reference path: rank 0 rebroadcasts the whole tree.
+            # Metered per receiving rank so the recovery lane can gate
+            # peer-restore's rank-0 bytes against this baseline.
+            if world > 1 and hvd_rt.process_rank() != 0:
+                import jax
+                _metrics.CKPT_RESTORE_BYTES.inc(
+                    _ckpt.tree_nbytes(
+                        jax.tree_util.tree_leaves(self._saved_state)),
+                    labels={"source": "rank0"})
+            super().sync()
+            # Keep commit counts aligned after a broadcast restore: the
+            # snapshot trigger and the fault grammar's at_step both key
+            # on _commits, so a joiner starting back at 0 would shard
+            # its snapshots under a different step than the survivors.
+            if plan is not None and not plan.fresh:
+                self._commits = max(self._commits, plan.step)
+        if world > 1:
+            _metrics.CKPT_RESTORE_SECONDS.observe(
+                _time.monotonic() - t0)
+
+    def _peer_restore(self, plan, me, leaves, treedef) -> bool:
+        """Execute this rank's side of the restore plan. True = state is
+        synced (attrs re-set from peer shards or already-agreed local
+        state); False = the caller must take the degraded broadcast."""
+        from .. import conformance as _conformance
+        from .. import metrics as _metrics
+        from .. import ops as hvd_ops
+
+        def _degraded(reason):
+            _conformance.record(
+                "elastic/state.py::JaxState._peer_restore",
+                "restore_source", (plan.step, "degraded", reason))
+            _metrics.CKPT_DEGRADED_RESTORES.inc(
+                labels={"reason": reason})
+            return False
+
+        if plan.degraded_reason is not None:
+            return _degraded(plan.degraded_reason)
+        if not plan.needy:
+            # Removal-only world agreement: every rank holds the committed
+            # step already — skipping the broadcast IS the restore.
+            _conformance.record(
+                "elastic/state.py::JaxState._peer_restore",
+                "restore_source", (plan.step, "peer", 0))
+            self._set_attrs()
+            return True
+        new_leaves, reason = _ckpt.run_peer_transfers(
+            plan, me, leaves, allgather=hvd_ops.allgather_object)
+        if reason is not None:
+            return _degraded(reason)
+        _conformance.record(
+            "elastic/state.py::JaxState._peer_restore",
+            "restore_source", (plan.step, "peer", len(plan.needy)))
+        if me in plan.needy:
+            import jax
+            self._saved_state = jax.tree_util.tree_unflatten(
+                treedef, new_leaves)
+            self._commits = plan.step
+        self._set_attrs()
+        return True
 
 
 def run_fn(func, reset):

@@ -83,6 +83,15 @@ def enabled() -> bool:
     return envs.health_interval_s() > 0.0
 
 
+def watchdog_budget_s() -> float:
+    """Upper bound on how long a peer death can go undeclared: one beat
+    interval of publish skew plus the no-beat timeout. Blocking
+    protocols that promise to "fail over within the watchdog budget"
+    (the checkpoint peer-restore shard pulls, docs/checkpoint.md) size
+    their wait deadlines from this instead of re-deriving the knobs."""
+    return envs.health_interval_s() + envs.health_timeout_s()
+
+
 class HealthWatchdog:
     """One rank's view of its peers' liveness over a shared KV store.
 

@@ -127,6 +127,13 @@ class Channel:
             self.slot_id, self.pos, self.count, payload,
             timeout=_timeout_s(), failure_check=self._failure_check)
 
+    def transfer(self, payload):
+        """Pairwise hand-off (:meth:`LoopbackHub.transfer`): both sides
+        return the owner's (position 0) payload."""
+        return self.hub.transfer(
+            self.slot_id, self.pos, payload,
+            timeout=_timeout_s(), failure_check=self._failure_check)
+
 
 def _failure_probe(ctx, pset):
     """Failure check evaluated while parked on a slot: the rank's own
@@ -193,4 +200,24 @@ def object_channel() -> Channel | None:
     slot_id = scope + (occurrence,)
     from ..process_sets import global_process_set
     return Channel(ctx.world.hub, slot_id, runtime.process_rank(), n,
+                   _failure_probe(ctx, global_process_set))
+
+
+def peer_channel(tag: tuple, role: int) -> Channel | None:
+    """Pairwise channel for one checkpoint shard hand-off (``hub.
+    transfer``), or None when the KV fallback must carry it (no loopback
+    world). Unlike collective slots, the pair's identity is fully
+    carried by ``tag`` — the restore protocol derives one globally
+    unique tag per (step, owner, puller, range, attempt) from the
+    manifest-agree round, so no occurrence counter is needed (and none
+    would be safe: only two of the world's ranks ever touch the slot)."""
+    ctx = _ctx.current()
+    if ctx is None or ctx.runtime_state is None or ctx.world is None:
+        return None
+    ctx.check_alive()
+    scope = ("ckpt", envs.get(envs.COORDINATOR_ADDR, "local"),
+             envs.get(envs.COORDINATOR_PORT, "0"))
+    slot_id = scope + tuple(tag)
+    from ..process_sets import global_process_set
+    return Channel(ctx.world.hub, slot_id, role, 2,
                    _failure_probe(ctx, global_process_set))

@@ -187,6 +187,20 @@ class LoopbackHub:
                                      timeout=timeout,
                                      failure_check=failure_check)
 
+    def transfer(self, slot_id: tuple, role: int, payload, *,
+                 timeout: float, failure_check=None):
+        """Pairwise hand-off (checkpoint shard pull, docs/checkpoint.md):
+        the owner (role 0) posts its payload, the puller (role 1) posts
+        a placeholder, and both return the owner's payload. Riding
+        ``exchange_compute`` keeps the failure semantics of every other
+        rendezvous: a dead peer surfaces through ``failure_check``
+        within the watchdog budget, and teardown ``fail_all`` poisons a
+        half-met transfer instead of stranding its payload."""
+        return self.exchange_compute(slot_id, role, 2, payload,
+                                     lambda vals: vals[0],
+                                     timeout=timeout,
+                                     failure_check=failure_check)
+
     # -- internals ---------------------------------------------------------
 
     def _raise_poisoned(self) -> None:

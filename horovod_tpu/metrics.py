@@ -167,6 +167,19 @@ def _all_stores() -> list[_Store]:
             _ctx_stores.values(), key=lambda s: s.rank)
 
 
+def reset_all(*instruments) -> None:
+    """Drop the named instruments' series in EVERY live store — the
+    process store and all rank worlds' (``_Instrument.reset`` only
+    touches the calling thread's own store). Bench lanes that run
+    several loopback worlds in one process use this to isolate each
+    lane's counters."""
+    names = {inst.name for inst in instruments}
+    with _mu:
+        for store in [_process_store] + list(_ctx_stores.values()):
+            for k in [k for k in store.values if k[0] in names]:
+                del store.values[k]
+
+
 # --------------------------------------------------------------------------
 # instruments
 # --------------------------------------------------------------------------
@@ -578,9 +591,48 @@ ELASTIC_POLICY_DECISIONS = counter(
     "hvd_elastic_policy_decisions_total",
     "Autoscale policy decisions by action (add / remove / evict / hold) "
     "and reason (slo-breach / idle / straggler / stale-round / protected "
-    "/ error); rank names the blamed global rank on evictions, empty "
-    "otherwise.",
+    "/ restore-cost / error); rank names the blamed global rank on "
+    "evictions, empty otherwise.",
     labels=("action", "reason", "rank"), always=True)
+
+# -- checkpoint state plane (checkpoint.py, docs/checkpoint.md) ------------
+CKPT_SNAPSHOT_SECONDS = histogram(
+    "hvd_ckpt_snapshot_seconds",
+    "Background snapshot duration on the writer thread: this rank's "
+    "shard pickled + written + fsync-renamed (rank 0 adds the manifest "
+    "wait/write) — off the training critical path by construction.",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0))
+CKPT_SHARDS_WRITTEN = counter(
+    "hvd_ckpt_shards_written_total",
+    "Snapshot shards durably written by this rank (one per triggered "
+    "snapshot that completed its atomic rename).")
+CKPT_RESTORE_SECONDS = histogram(
+    "hvd_ckpt_restore_seconds",
+    "Re-form state re-sync duration: manifest-agree round entered -> "
+    "attributes restored (peer shard pulls, or the degraded rank-0 "
+    "broadcast). The restore half of the recovery-SLO lane.",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
+CKPT_PEER_SHARDS_PULLED = counter(
+    "hvd_ckpt_peer_shards_pulled_total",
+    "Shards this rank pulled from survivors during peer-restore, by "
+    "transport (hub = in-world loopback rendezvous, kv = the fallback "
+    "KV channel).",
+    labels=("transport",))
+CKPT_RESTORE_BYTES = counter(
+    "hvd_ckpt_restore_bytes_total",
+    "State-restore payload bytes this rank received, by source (rank0 "
+    "= served by rank 0: degraded broadcasts plus shards rank 0 "
+    "happened to own; peer = shards served by other survivors). The "
+    "recovery lane gates peer-restore moving strictly fewer rank0 "
+    "bytes than the broadcast baseline.",
+    labels=("source",))
+CKPT_DEGRADED_RESTORES = counter(
+    "hvd_ckpt_degraded_restores_total",
+    "Re-forms that fell back to the rank-0 full-tree broadcast, by "
+    "reason (quorum = too few consistent survivors, structure = the "
+    "joiner's state tree shape disagreed, pull-failed = shard pulls "
+    "exhausted their failover retry).",
+    labels=("reason",))
 
 
 # --------------------------------------------------------------------------

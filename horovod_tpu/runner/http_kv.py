@@ -273,6 +273,18 @@ class KVServer:
             return sorted(k for k in self._httpd.store  # type: ignore[attr-defined]
                           if k.startswith(prefix))
 
+    def delete(self, key: str) -> None:
+        """Server-side mirror of ``do_DELETE``: drop ``key`` and every
+        key under it as a scope (the driver's GC of per-round state —
+        e.g. stale checkpoint shard hand-off keys at round publication)."""
+        assert self._httpd is not None
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            store = self._httpd.store  # type: ignore[attr-defined]
+            for k in [k for k in store
+                      if k == key or k.startswith(key.rstrip("/") + "/")]:
+                del store[k]
+                self._httpd.times.pop(k, None)  # type: ignore[attr-defined]
+
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()

@@ -105,6 +105,10 @@ QOS_CLASSES = "QOS_CLASSES"  # per-tenant class spec string (docs/qos.md grammar
 CONFORMANCE = "CONFORMANCE"  # cross-rank lockstep conformance recorder (0 = off)
 CONFORMANCE_DIR = "CONFORMANCE_DIR"  # per-rank trace dump directory (empty = dump on demand only)
 CONFORMANCE_RING = "CONFORMANCE_RING"  # full-payload ring capacity per rank recorder
+CKPT_DIR = "CKPT_DIR"  # sharded async snapshot directory (empty = state plane off)
+CKPT_INTERVAL = "CKPT_INTERVAL"  # commits between background snapshots
+CKPT_PEER_RESTORE = "CKPT_PEER_RESTORE"  # re-form state re-sync from survivor shards (0 = rank-0 broadcast)
+CKPT_SHARD_QUORUM = "CKPT_SHARD_QUORUM"  # min survivors holding a consistent manifest before peer-restore runs
 
 # rendezvous / launcher env seeded by `hvdrun` (reference:
 # HOROVOD_RANK/SIZE/LOCAL_RANK... seeded at gloo_run.py:65-101,201-226)
@@ -456,6 +460,44 @@ def conformance_dir() -> str:
 
 def conformance_ring() -> int:
     return max(0, get_int(CONFORMANCE_RING, DEFAULT_CONFORMANCE_RING))
+
+
+# Checkpoint state plane defaults (horovod_tpu/checkpoint.py,
+# docs/checkpoint.md). Snapshotting every commit would put a host-side
+# pickle+write on every step's critical path shadow; every 10th commit
+# keeps the restore point seconds-fresh at commit-per-step cadence while
+# the background thread stays comfortably ahead. Peer-restore defaults
+# ON unconditionally — it serves from the survivors' LIVE committed
+# trees (no snapshot directory required) and the degraded rank-0
+# broadcast stays available as the typed fallback, so the fast path is
+# safe to prefer. Quorum 1 admits the smallest useful survivor set; jobs
+# that fear a lone corrupted survivor raise it.
+DEFAULT_CKPT_INTERVAL = 10
+DEFAULT_CKPT_SHARD_QUORUM = 1
+
+
+def ckpt_dir() -> str:
+    """``HVD_CKPT_DIR``: root directory for sharded background
+    snapshots (``horovod_tpu/checkpoint.py`` state plane). Empty
+    (default) = the state plane is off and elastic re-forms re-sync via
+    the rank-0 broadcast only."""
+    return (get(CKPT_DIR, "") or "").strip()
+
+
+def ckpt_interval() -> int:
+    return max(1, get_int(CKPT_INTERVAL, DEFAULT_CKPT_INTERVAL))
+
+
+def ckpt_peer_restore_enabled() -> bool:
+    """Whether a re-formed world re-syncs model state by pulling shards
+    from survivors instead of the rank-0 full-tree broadcast. Only
+    meaningful when survivors exist; the degraded broadcast path always
+    remains the fallback."""
+    return get_bool(CKPT_PEER_RESTORE, True)
+
+
+def ckpt_shard_quorum() -> int:
+    return max(1, get_int(CKPT_SHARD_QUORUM, DEFAULT_CKPT_SHARD_QUORUM))
 
 
 def qos_enabled() -> bool:

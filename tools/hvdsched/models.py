@@ -970,6 +970,114 @@ def qos_inversion_demo():
         inv.join_thread(t)
 
 
+def ckpt_snapshot():
+    """The checkpoint state plane's snapshot writer (docs/checkpoint.md)
+    racing the commit trigger and re-form teardown: commits hand leaf
+    slices to the background thread (latest-wins replacement), a
+    ``stop()`` lands mid-write, and a late commit races the stop. The
+    invariant asserted is the manifest protocol's whole contract: every
+    manifest on disk names only complete digest-verified shards, and
+    ``latest`` only ever points at a step whose manifest exists — no
+    interleaving may expose a torn tree."""
+    import json
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu import checkpoint as ck
+    inv = _inv()
+    tmp = tempfile.mkdtemp(prefix="hvdsched-ckpt-")
+    plane = ck.StatePlane(tmp, rank=0, world=1, interval=1)
+
+    class _State:  # the State.commit surface the trigger reads
+        _commits = 0
+        _saved_state: dict = {}
+
+    state = _State()
+
+    def committer():
+        for _ in range(3):
+            state._commits += 1
+            state._saved_state = {"w": np.full(4, state._commits)}
+            plane.note_commit(state)
+
+    def teardown():
+        plane.stop()  # the re-form path: stop may land mid-write
+
+    ts = [inv.spawn_thread(committer, name="committer"),
+          inv.spawn_thread(teardown, name="teardown")]
+    for t in ts:
+        inv.join_thread(t)
+    plane.stop()
+    try:
+        manifests = {}
+        for name in os.listdir(tmp):
+            if name.startswith("manifest-") and name.endswith(".json"):
+                with open(os.path.join(tmp, name), "rb") as f:
+                    man = json.loads(f.read().decode())
+                manifests[int(man["step"])] = man
+                for meta in man["shards"]:
+                    p = os.path.join(
+                        ck.step_dir(tmp, man["step"]),
+                        f"shard-{meta['lo']}-{meta['hi']}.bin")
+                    with open(p, "rb") as f:
+                        payload = f.read()
+                    if ck.shard_digest(payload) != meta["digest"]:
+                        raise AssertionError(
+                            f"manifest for step {man['step']} names a "
+                            f"corrupt shard [{meta['lo']},{meta['hi']})")
+        latest = os.path.join(tmp, "latest")
+        if os.path.exists(latest):
+            with open(latest, "rb") as f:
+                pointed = int(f.read().decode())
+            if pointed not in manifests:
+                raise AssertionError(
+                    f"`latest` points at step {pointed} but no manifest "
+                    f"exists for it (have {sorted(manifests)})")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def stale_manifest_restore_demo():
+    """PLANTED torn-manifest read: the writer publishes a new snapshot
+    by moving the ``latest`` pointer FIRST and writing the manifest
+    after — the inverse of the real plane's order (manifest, then
+    pointer, each atomic) — while the restore reads the pointer and
+    then the manifest without re-checking the generation in between.
+    Most schedules pass (the writer finishes before the reader looks);
+    exploration must FIND the window where the pointer names a step
+    whose manifest has not landed, and the model-assertion finding
+    replays byte-for-byte from (seed, trace)."""
+    inv = _inv()
+    mu = inv.make_lock("ckptdemo.mu")
+    store = {"latest": 1, "manifest": {1: {"step": 1}}}
+
+    def writer():
+        # BUG: pointer before manifest — the torn window
+        with mu:
+            store["latest"] = 2
+        with mu:
+            store["manifest"][2] = {"step": 2}
+
+    def reader():
+        with mu:
+            step = store["latest"]
+        # BUG: no generation re-check between pointer and manifest read
+        with mu:
+            man = store["manifest"].get(step)
+        if man is None or man["step"] != step:
+            raise AssertionError(
+                f"restore read latest={step} but its manifest is "
+                f"{man!r}: the pointer moved before the manifest landed")
+
+    ts = [inv.spawn_thread(writer, name="writer"),
+          inv.spawn_thread(reader, name="reader")]
+    for t in ts:
+        inv.join_thread(t)
+
+
 MATRIX = {
     "enqueue-flush": enqueue_flush_quiesce,
     "flush-abort": flush_abort_race,
@@ -983,6 +1091,7 @@ MATRIX = {
     "pr6-chain-guard": pr6_chain_guard,
     "elastic-reform": elastic_reform,
     "autoscale-decision": autoscale_decision,
+    "ckpt-snapshot": ckpt_snapshot,
 }
 
 DEMOS = {
@@ -995,6 +1104,7 @@ DEMOS = {
     "pr6-unguarded": pr6_unguarded,
     "stale-plan-after-resize-demo": stale_plan_after_resize_demo,
     "evict-during-reform-demo": evict_during_reform_demo,
+    "stale-manifest-restore-demo": stale_manifest_restore_demo,
 }
 
 MODELS = {**MATRIX, **DEMOS}
